@@ -1,0 +1,81 @@
+"""Configuration system for mosaic_tpu.
+
+TPU-native analogue of the reference's ``MosaicExpressionConfig``
+(reference: functions/MosaicExpressionConfig.scala:19-117) and the conf-key
+namespace in mosaic/package.scala:21-43.  Instead of Spark confs serialized
+into Catalyst expressions, we keep an immutable dataclass that every op
+receives (or reads from a context-local default).  It is a plain pytree leaf
+holder — safe to close over in jitted functions (only static fields).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Conf-key namespace kept string-compatible with the reference so users can
+# port settings 1:1 (reference: mosaic/package.scala:21-43).
+MOSAIC_INDEX_SYSTEM = "mosaic.index.system"
+MOSAIC_GEOMETRY_API = "mosaic.geometry.api"
+MOSAIC_RASTER_CHECKPOINT = "mosaic.raster.checkpoint"
+MOSAIC_RASTER_USE_CHECKPOINT = "mosaic.raster.use.checkpoint"
+MOSAIC_RASTER_TMP_PREFIX = "mosaic.raster.tmp.prefix"
+MOSAIC_RASTER_BLOCKSIZE = "mosaic.raster.blocksize"
+
+MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
+MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
+MOSAIC_RASTER_BLOCKSIZE_DEFAULT = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class MosaicConfig:
+    """Immutable snapshot of framework settings.
+
+    Mirrors MosaicExpressionConfig: the (index system, geometry backend)
+    pair plus raster checkpoint behaviour travels with every operation so
+    compute code never consults global mutable state.
+    """
+
+    index_system: str = "H3"          # "H3" | "BNG" | "CUSTOM(...)"
+    geometry_api: str = "JAX"         # device-vectorized backend (only impl)
+    raster_checkpoint: str = MOSAIC_RASTER_CHECKPOINT_DEFAULT
+    raster_use_checkpoint: bool = False
+    raster_tmp_prefix: str = MOSAIC_RASTER_TMP_PREFIX_DEFAULT
+    raster_blocksize: int = MOSAIC_RASTER_BLOCKSIZE_DEFAULT
+    # Device-compute precision policy.  Cell assignment / PIP run in f32 on
+    # TPU with an epsilon "uncertainty band"; points inside the band are
+    # re-checked in f64 on host so results match the host reference exactly
+    # (design note: DESIGN.md §precision).
+    device_dtype: str = "float32"
+    exact_fallback: bool = True
+
+    @staticmethod
+    def from_confs(confs: dict) -> "MosaicConfig":
+        """Build from a reference-style string conf map."""
+        return MosaicConfig(
+            index_system=confs.get(MOSAIC_INDEX_SYSTEM, "H3"),
+            geometry_api=confs.get(MOSAIC_GEOMETRY_API, "JAX"),
+            raster_checkpoint=confs.get(
+                MOSAIC_RASTER_CHECKPOINT, MOSAIC_RASTER_CHECKPOINT_DEFAULT),
+            raster_use_checkpoint=str(
+                confs.get(MOSAIC_RASTER_USE_CHECKPOINT, "false")).lower()
+                == "true",
+            raster_tmp_prefix=confs.get(
+                MOSAIC_RASTER_TMP_PREFIX, MOSAIC_RASTER_TMP_PREFIX_DEFAULT),
+            raster_blocksize=int(
+                confs.get(MOSAIC_RASTER_BLOCKSIZE,
+                          MOSAIC_RASTER_BLOCKSIZE_DEFAULT)),
+        )
+
+
+_default_config: MosaicConfig = MosaicConfig()
+
+
+def set_default_config(cfg: MosaicConfig) -> None:
+    global _default_config
+    _default_config = cfg
+
+
+def default_config() -> MosaicConfig:
+    return _default_config

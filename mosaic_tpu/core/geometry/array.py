@@ -1,0 +1,277 @@
+"""Columnar geometry batches — the primary representation.
+
+The reference keeps geometries as row objects wrapping JTS
+(core/geometry/MosaicGeometry.scala:14) and only flattens to arrays at the
+Spark wire boundary (core/types/model/InternalGeometry.scala:23-27:
+``boundaries: Array[Array[InternalCoord]]``).  TPU-first we invert that: the
+flattened, offset-indexed coordinate array IS the geometry, living in host
+RAM (float64) and shipped to device HBM (float32 blocks) for kernels.
+
+Layout (GeoArrow-style triple nesting, covers all 7 OGC types):
+
+    coords        [V, D]  float64   all vertices, D in {2, 3}
+    ring_offsets  [R+1]   int64     vertex span of each ring / linestring / point
+    part_offsets  [P+1]   int64     ring span of each part (polygon = shell+holes)
+    geom_offsets  [G+1]   int64     part span of each geometry
+    types         [G]     uint8     GeometryType code per geometry
+    srid          int               spatial reference id (0 = unset, 4326 default)
+
+A Point is one part with one ring of one vertex; a LineString one part/one
+ring; a Polygon one part with shell ring + hole rings; Multi* and
+GeometryCollection span several parts.  ``types`` disambiguates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+
+class GeometryType(enum.IntEnum):
+    """OGC geometry type codes (match WKB integer codes).
+
+    Reference enum: core/types/GeometryTypeEnum.scala.
+    """
+
+    POINT = 1
+    LINESTRING = 2
+    POLYGON = 3
+    MULTIPOINT = 4
+    MULTILINESTRING = 5
+    MULTIPOLYGON = 6
+    GEOMETRYCOLLECTION = 7
+
+    @property
+    def wkt_name(self) -> str:
+        return {
+            1: "POINT", 2: "LINESTRING", 3: "POLYGON", 4: "MULTIPOINT",
+            5: "MULTILINESTRING", 6: "MULTIPOLYGON", 7: "GEOMETRYCOLLECTION",
+        }[int(self)]
+
+
+_SINGLE_OF = {
+    GeometryType.MULTIPOINT: GeometryType.POINT,
+    GeometryType.MULTILINESTRING: GeometryType.LINESTRING,
+    GeometryType.MULTIPOLYGON: GeometryType.POLYGON,
+}
+_MULTI_OF = {v: k for k, v in _SINGLE_OF.items()}
+
+
+@dataclasses.dataclass
+class GeometryArray:
+    """A batch of geometries in flattened columnar form."""
+
+    coords: np.ndarray        # [V, D] float64
+    ring_offsets: np.ndarray  # [R+1] int64
+    part_offsets: np.ndarray  # [P+1] int64
+    geom_offsets: np.ndarray  # [G+1] int64
+    types: np.ndarray         # [G] uint8
+    srid: int = 4326
+
+    # ---------------------------------------------------------- invariants
+    def __post_init__(self):
+        self.coords = np.asarray(self.coords, dtype=np.float64)
+        if self.coords.ndim != 2:
+            self.coords = self.coords.reshape(-1, 2)
+        self.ring_offsets = np.asarray(self.ring_offsets, dtype=np.int64)
+        self.part_offsets = np.asarray(self.part_offsets, dtype=np.int64)
+        self.geom_offsets = np.asarray(self.geom_offsets, dtype=np.int64)
+        self.types = np.asarray(self.types, dtype=np.uint8)
+
+    def validate(self) -> None:
+        assert self.ring_offsets[0] == 0
+        assert self.part_offsets[0] == 0
+        assert self.geom_offsets[0] == 0
+        assert self.ring_offsets[-1] == len(self.coords)
+        assert self.part_offsets[-1] == len(self.ring_offsets) - 1
+        assert self.geom_offsets[-1] == len(self.part_offsets) - 1
+        assert len(self.types) == len(self)
+        assert np.all(np.diff(self.ring_offsets) >= 0)
+        assert np.all(np.diff(self.part_offsets) >= 0)
+        assert np.all(np.diff(self.geom_offsets) >= 0)
+
+    # ------------------------------------------------------------- basics
+    def __len__(self) -> int:
+        return len(self.geom_offsets) - 1
+
+    @property
+    def ndim(self) -> int:
+        return self.coords.shape[1]
+
+    @property
+    def num_rings(self) -> int:
+        return len(self.ring_offsets) - 1
+
+    @property
+    def num_parts(self) -> int:
+        return len(self.part_offsets) - 1
+
+    def geom_type(self, i: int) -> GeometryType:
+        return GeometryType(int(self.types[i]))
+
+    # ------------------------------------------------------ constructors
+    @staticmethod
+    def empty(ndim: int = 2, srid: int = 4326) -> "GeometryArray":
+        return GeometryArray(
+            coords=np.zeros((0, ndim)), ring_offsets=np.zeros(1, np.int64),
+            part_offsets=np.zeros(1, np.int64),
+            geom_offsets=np.zeros(1, np.int64),
+            types=np.zeros(0, np.uint8), srid=srid)
+
+    @staticmethod
+    def from_points(xy: np.ndarray, srid: int = 4326) -> "GeometryArray":
+        """Vectorized constructor for a batch of POINTs from an [N, D] array."""
+        xy = np.atleast_2d(np.asarray(xy, dtype=np.float64))
+        n = len(xy)
+        ar = np.arange(n + 1, dtype=np.int64)
+        return GeometryArray(
+            coords=xy, ring_offsets=ar, part_offsets=ar, geom_offsets=ar,
+            types=np.full(n, GeometryType.POINT, np.uint8), srid=srid)
+
+    @staticmethod
+    def concat(arrays: Sequence["GeometryArray"]) -> "GeometryArray":
+        arrays = [a for a in arrays if len(a) > 0] or [GeometryArray.empty()]
+        ndim = max(a.ndim for a in arrays)
+        coords, rings, parts, geoms, types = [], [0], [0], [0], []
+        for a in arrays:
+            c = a.coords
+            if c.shape[1] < ndim:
+                c = np.pad(c, ((0, 0), (0, ndim - c.shape[1])))
+            coords.append(c)
+            rings.extend((a.ring_offsets[1:] + rings[-1]).tolist())
+            parts.extend((a.part_offsets[1:] + parts[-1]).tolist())
+            geoms.extend((a.geom_offsets[1:] + geoms[-1]).tolist())
+            types.append(a.types)
+        return GeometryArray(
+            coords=np.concatenate(coords) if coords else np.zeros((0, ndim)),
+            ring_offsets=np.asarray(rings, np.int64),
+            part_offsets=np.asarray(parts, np.int64),
+            geom_offsets=np.asarray(geoms, np.int64),
+            types=np.concatenate(types), srid=arrays[0].srid)
+
+    # -------------------------------------------------------- python view
+    def geom_slices(self, i: int) -> Tuple[GeometryType, List[List[np.ndarray]]]:
+        """Return (type, parts) where parts is a list of lists of [n,D] rings."""
+        t = self.geom_type(i)
+        p0, p1 = self.geom_offsets[i], self.geom_offsets[i + 1]
+        parts = []
+        for p in range(p0, p1):
+            r0, r1 = self.part_offsets[p], self.part_offsets[p + 1]
+            rings = [self.coords[self.ring_offsets[r]:self.ring_offsets[r + 1]]
+                     for r in range(r0, r1)]
+            parts.append(rings)
+        return t, parts
+
+    def take(self, idx) -> "GeometryArray":
+        """Gather a subset of geometries (host-side)."""
+        idx = np.asarray(idx, dtype=np.int64).reshape(-1)
+        builder = GeometryBuilder(ndim=self.ndim, srid=self.srid)
+        for i in idx:
+            t, parts = self.geom_slices(int(i))
+            builder.add(t, parts)
+        return builder.finish()
+
+    def __getitem__(self, i) -> "GeometryArray":
+        if isinstance(i, (int, np.integer)):
+            return self.take([i])
+        return self.take(np.arange(len(self))[i])
+
+    # -------------------------------------------------------- aggregates
+    def vertex_starts(self) -> np.ndarray:
+        """First-vertex index of each geometry (monotone). [G+1] int64."""
+        return self.ring_offsets[self.part_offsets[self.geom_offsets]]
+
+    def vertex_counts(self) -> np.ndarray:
+        """Vertices per geometry. [G] int64."""
+        return np.diff(self.vertex_starts())
+
+    def bboxes(self) -> np.ndarray:
+        """Per-geometry [G, 4] (xmin, ymin, xmax, ymax); NaN for empties."""
+        g = len(self)
+        out = np.full((g, 4), np.nan)
+        vc = self.vertex_counts()
+        # geometry id for each vertex
+        vgeom = self.vertex_geom_ids()
+        if len(self.coords):
+            x, y = self.coords[:, 0], self.coords[:, 1]
+            for c, (col, fn) in enumerate(
+                    [(x, np.minimum), (y, np.minimum),
+                     (x, np.maximum), (y, np.maximum)]):
+                acc = np.full(g, np.inf if fn is np.minimum else -np.inf)
+                fn.at(acc, vgeom, col)
+                out[:, c] = acc
+        out[vc == 0] = np.nan
+        return out
+
+    def vertex_geom_ids(self) -> np.ndarray:
+        """Geometry id for every vertex. [V] int64."""
+        return np.repeat(np.arange(len(self)),
+                         self.vertex_counts()).astype(np.int64)
+
+    def ring_part_ids(self) -> np.ndarray:
+        return np.repeat(np.arange(self.num_parts),
+                         np.diff(self.part_offsets)).astype(np.int64)
+
+    def part_geom_ids(self) -> np.ndarray:
+        return np.repeat(np.arange(len(self)),
+                         np.diff(self.geom_offsets)).astype(np.int64)
+
+    def ring_geom_ids(self) -> np.ndarray:
+        return self.part_geom_ids()[self.ring_part_ids()]
+
+
+class GeometryBuilder:
+    """Incremental host-side builder for GeometryArray."""
+
+    def __init__(self, ndim: int = 2, srid: int = 4326):
+        self.ndim = ndim
+        self.srid = srid
+        self._coords: List[np.ndarray] = []
+        self._rings = [0]
+        self._parts = [0]
+        self._geoms = [0]
+        self._types: List[int] = []
+        self._nv = 0
+
+    def add(self, gtype: GeometryType,
+            parts: Iterable[Iterable[np.ndarray]]) -> None:
+        for rings in parts:
+            for ring in rings:
+                ring = np.atleast_2d(np.asarray(ring, dtype=np.float64))
+                if ring.size and ring.shape[1] > self.ndim:
+                    self.ndim = ring.shape[1]
+                self._coords.append(ring.reshape(-1, ring.shape[1]
+                                                 if ring.size else self.ndim))
+                self._nv += len(self._coords[-1])
+                self._rings.append(self._nv)
+            self._parts.append(len(self._rings) - 1)
+        self._geoms.append(len(self._parts) - 1)
+        self._types.append(int(gtype))
+
+    def add_point(self, xy) -> None:
+        self.add(GeometryType.POINT, [[np.atleast_2d(xy)]])
+
+    def add_linestring(self, xy) -> None:
+        self.add(GeometryType.LINESTRING, [[xy]])
+
+    def add_polygon(self, shell, holes=()) -> None:
+        self.add(GeometryType.POLYGON, [[shell, *holes]])
+
+    def add_multipolygon(self, polys) -> None:
+        self.add(GeometryType.MULTIPOLYGON, [list(p) for p in polys])
+
+    def finish(self) -> GeometryArray:
+        coords = [np.zeros((0, self.ndim))]
+        for c in self._coords:
+            if c.shape[1] < self.ndim:
+                c = np.pad(c, ((0, 0), (0, self.ndim - c.shape[1])))
+            coords.append(c)
+        return GeometryArray(
+            coords=np.concatenate(coords),
+            ring_offsets=np.asarray(self._rings, np.int64),
+            part_offsets=np.asarray(self._parts, np.int64),
+            geom_offsets=np.asarray(self._geoms, np.int64),
+            types=np.asarray(self._types, np.uint8), srid=self.srid)

@@ -1,0 +1,122 @@
+"""Vectorized geometry measures (JAX).
+
+Reference counterpart: the measure methods on
+core/geometry/MosaicGeometry.scala (getArea, getLength, getCentroid,
+minMaxCoord, distance) executed row-at-a-time through JTS.  Here each
+measure is one fused XLA computation over padded EdgeBlocks — measures for
+a whole batch in one device launch.
+
+Planar (Cartesian) semantics in the geometry's own CRS, matching JTS.
+Spherical helpers (haversine) live at the bottom.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .padded import EdgeBlocks
+
+EARTH_RADIUS_M = 6_371_008.8  # mean Earth radius (IUGG)
+
+
+def _cross(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return a[..., 0] * b[..., 1] - a[..., 1] * b[..., 0]
+
+
+def area(e: EdgeBlocks) -> jnp.ndarray:
+    """Signed shoelace area per geometry. [G].
+
+    Winding was normalized on build (shells CCW, holes CW) so the signed sum
+    equals shell area minus hole area; clamp at 0 for degenerate inputs.
+    """
+    tri = _cross(e.a, e.b) * e.mask
+    return jnp.maximum(0.5 * jnp.sum(tri, axis=-1), 0.0)
+
+
+def length(e: EdgeBlocks) -> jnp.ndarray:
+    """Sum of edge lengths per geometry (perimeter for polygons). [G]."""
+    d = jnp.linalg.norm(e.b - e.a, axis=-1) * e.mask
+    return jnp.sum(d, axis=-1)
+
+
+def centroid(e: EdgeBlocks) -> jnp.ndarray:
+    """Area-weighted centroid per geometry; falls back to vertex mean for
+    zero-area geometries (points/lines). [G, 2]."""
+    w = _cross(e.a, e.b) * e.mask
+    c = (e.a + e.b) * w[..., None]
+    A = jnp.sum(w, axis=-1)
+    poly_centroid = jnp.sum(c, axis=1) / (3.0 * A[:, None] + 1e-300)
+    # Fallback: mean of edge midpoints weighted by edge length (lines), or
+    # plain vertex mean (degenerate).
+    elen = jnp.linalg.norm(e.b - e.a, axis=-1) * e.mask
+    mid = 0.5 * (e.a + e.b)
+    L = jnp.sum(elen, axis=-1)
+    line_centroid = jnp.sum(mid * elen[..., None], axis=1) / (L[:, None] + 1e-300)
+    nvalid = jnp.sum(e.mask, axis=-1)
+    vert_mean = jnp.sum(e.a * e.mask[..., None], axis=1) / (
+        nvalid[:, None] + 1e-300)
+    out = jnp.where(jnp.abs(A)[:, None] > 1e-30, poly_centroid,
+                    jnp.where(L[:, None] > 1e-30, line_centroid, vert_mean))
+    return out
+
+
+def bounds(e: EdgeBlocks) -> jnp.ndarray:
+    """[G, 4] (xmin, ymin, xmax, ymax) over valid edges."""
+    big = jnp.asarray(jnp.inf, e.a.dtype)
+    ax = jnp.where(e.mask, e.a[..., 0], big)
+    ay = jnp.where(e.mask, e.a[..., 1], big)
+    bx = jnp.where(e.mask, e.b[..., 0], big)
+    by = jnp.where(e.mask, e.b[..., 1], big)
+    xmin = jnp.minimum(ax.min(-1), bx.min(-1))
+    ymin = jnp.minimum(ay.min(-1), by.min(-1))
+    ax = jnp.where(e.mask, e.a[..., 0], -big)
+    ay = jnp.where(e.mask, e.a[..., 1], -big)
+    bx = jnp.where(e.mask, e.b[..., 0], -big)
+    by = jnp.where(e.mask, e.b[..., 1], -big)
+    xmax = jnp.maximum(ax.max(-1), bx.max(-1))
+    ymax = jnp.maximum(ay.max(-1), by.max(-1))
+    return jnp.stack([xmin, ymin, xmax, ymax], axis=-1)
+
+
+def point_segment_dist2(p: jnp.ndarray, a: jnp.ndarray,
+                        b: jnp.ndarray) -> jnp.ndarray:
+    """Squared distance from points to segments, broadcasting."""
+    ab = b - a
+    ap = p - a
+    denom = jnp.sum(ab * ab, axis=-1)
+    t = jnp.clip(jnp.sum(ap * ab, axis=-1) / (denom + 1e-300), 0.0, 1.0)
+    proj = a + t[..., None] * ab
+    d = p - proj
+    return jnp.sum(d * d, axis=-1)
+
+
+def distance_points_to_geoms(points: jnp.ndarray,
+                             e: EdgeBlocks) -> jnp.ndarray:
+    """[N, G] planar distance from each point to each geometry's edges.
+
+    Distance 0 is NOT shortcut for containment here; use
+    predicates.contains for inside tests (JTS distance to a polygon
+    interior is 0 — callers combine the two, see functions.st.st_distance).
+    """
+    p = points[:, None, None, :]           # [N, 1, 1, 2]
+    d2 = point_segment_dist2(p, e.a[None], e.b[None])   # [N, G, E]
+    d2 = jnp.where(e.mask[None], d2, jnp.inf)
+    return jnp.sqrt(jnp.min(d2, axis=-1))
+
+
+def pairwise_point_distance(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[N, M] Euclidean distances between two point sets."""
+    diff = a[:, None, :] - b[None, :, :]
+    return jnp.linalg.norm(diff, axis=-1)
+
+
+def haversine(lat1, lng1, lat2, lng2, radius: float = EARTH_RADIUS_M / 1000.0):
+    """Great-circle distance (default km — matches reference ST_Haversine,
+    expressions/geometry/ST_Haversine.scala which returns km)."""
+    lat1, lng1, lat2, lng2 = map(jnp.radians, (lat1, lng1, lat2, lng2))
+    dlat = lat2 - lat1
+    dlng = lng2 - lng1
+    h = jnp.sin(dlat / 2) ** 2 + jnp.cos(lat1) * jnp.cos(lat2) * \
+        jnp.sin(dlng / 2) ** 2
+    return 2 * radius * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))

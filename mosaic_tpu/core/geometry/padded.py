@@ -1,0 +1,144 @@
+"""Device-facing padded edge blocks.
+
+TPU kernels need static shapes; ragged GeometryArray batches are padded into
+dense ``[G, E, 2]`` edge tensors here.  This is the analogue of the
+reference's InternalGeometry (core/types/model/InternalGeometry.scala:23-27)
+ragged coords — but laid out for the VPU/MXU: fixed edge capacity per
+geometry, boolean masks for validity, winding normalized so signed shoelace
+area "just works" with holes (shells CCW, holes CW).
+
+Edge capacity is chosen per batch (next power of two ≥ max edge count, min
+8) so XLA compiles one kernel per bucket, not per batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .array import GeometryArray, GeometryType
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class EdgeBlocks:
+    """Dense per-geometry edge soup.
+
+    a, b: [G, E, 2] edge endpoints (directed a->b).
+    mask: [G, E] validity.
+    Winding: shell rings CCW, holes CW (normalized on build), so
+    0.5 * sum(cross(a, b)) is the polygon area with holes subtracted.
+    """
+
+    a: jnp.ndarray
+    b: jnp.ndarray
+    mask: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.a, self.b, self.mask), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def num_geoms(self) -> int:
+        return self.a.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.a.shape[1]
+
+
+def _ring_signed_area(ring: np.ndarray) -> float:
+    if len(ring) < 3:
+        return 0.0
+    x, y = ring[:, 0], ring[:, 1]
+    x2, y2 = np.roll(x, -1), np.roll(y, -1)
+    return 0.5 * float(np.sum(x * y2 - x2 * y))
+
+
+def _pad_cap(n: int, minimum: int = 8) -> int:
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def build_edges(arr: GeometryArray, capacity: Optional[int] = None,
+                dtype=jnp.float32, normalize: bool = True) -> EdgeBlocks:
+    """Build padded edge blocks from a GeometryArray (host-side).
+
+    Rings are closed implicitly (last->first edge added if not closed).
+    For polygon parts, the first ring of each part is the shell (forced CCW),
+    subsequent rings are holes (forced CW) — matching OGC ring semantics.
+    Points and linestrings yield their segments (open; no closing edge),
+    letting length/distance kernels reuse the same layout.
+    """
+    g = len(arr)
+    ring_part = arr.ring_part_ids()
+    part_geom = arr.part_geom_ids()
+    edges_per_geom: list[list[Tuple[np.ndarray, np.ndarray]]] = [
+        [] for _ in range(g)]
+    part_first_ring = {}
+    for r in range(arr.num_rings):
+        p = ring_part[r]
+        part_first_ring.setdefault(int(p), r)
+
+    for r in range(arr.num_rings):
+        v0, v1 = arr.ring_offsets[r], arr.ring_offsets[r + 1]
+        ring = arr.coords[v0:v1, :2]
+        if len(ring) == 0:
+            continue
+        gi = int(part_geom[ring_part[r]])
+        t = GeometryType(int(arr.types[gi]))
+        is_poly = t in (GeometryType.POLYGON, GeometryType.MULTIPOLYGON,
+                        GeometryType.GEOMETRYCOLLECTION) and len(ring) >= 3
+        if is_poly:
+            closed = ring if np.array_equal(ring[0], ring[-1]) else \
+                np.vstack([ring, ring[:1]])
+            body = closed[:-1]
+            if normalize:
+                sa = _ring_signed_area(body)
+                is_shell = part_first_ring[int(ring_part[r])] == r
+                if (is_shell and sa < 0) or (not is_shell and sa > 0):
+                    body = body[::-1]
+            a = body
+            b = np.roll(body, -1, axis=0)
+            edges_per_geom[gi].append((a, b))
+        elif len(ring) >= 2:
+            edges_per_geom[gi].append((ring[:-1], ring[1:]))
+        # single vertex (point): no edges
+
+    counts = [sum(len(a) for a, _ in e) for e in edges_per_geom]
+    cap = capacity or _pad_cap(max(counts) if counts else 1)
+    A = np.zeros((g, cap, 2), dtype=np.float64)
+    B = np.zeros((g, cap, 2), dtype=np.float64)
+    M = np.zeros((g, cap), dtype=bool)
+    for i, segs in enumerate(edges_per_geom):
+        k = 0
+        for a, b in segs:
+            n = len(a)
+            if k + n > cap:
+                raise ValueError(
+                    f"geometry {i} has {counts[i]} edges > capacity {cap}")
+            A[i, k:k + n] = a
+            B[i, k:k + n] = b
+            M[i, k:k + n] = True
+            k += n
+    return EdgeBlocks(jnp.asarray(A, dtype=dtype), jnp.asarray(B, dtype=dtype),
+                      jnp.asarray(M))
+
+
+def points_block(arr: GeometryArray, dtype=jnp.float32) -> jnp.ndarray:
+    """[G, 2] first-vertex per geometry (for POINT batches)."""
+    starts = arr.vertex_starts()[:-1]
+    counts = arr.vertex_counts()
+    safe = np.where(counts > 0, starts, 0)
+    pts = arr.coords[safe, :2]
+    pts = np.where(counts[:, None] > 0, pts, np.nan)
+    return jnp.asarray(pts, dtype=dtype)

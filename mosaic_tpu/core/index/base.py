@@ -1,0 +1,121 @@
+"""IndexSystem — the grid plugin boundary, vectorized.
+
+Reference counterpart: core/index/IndexSystem.scala:15-318 (pointToIndex,
+polyfill, kRing/kLoop, indexToGeometry, getBufferRadius, getBorderChips,
+getCoreChips, alignToGrid, area, cell-id formatting).  The reference's
+contract is scalar (one cell at a time); TPU-first every method takes and
+returns arrays so grid math runs as one vectorized computation for a whole
+batch of points/cells.
+
+Chipping (getCoreChips/getBorderChips) lives in core/tessellate.py — the
+engine only needs the primitives below, which is the whole point of the
+plugin boundary (SURVEY.md §2.1 "This is the boundary the TPU build
+re-implements").
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class IndexSystem(abc.ABC):
+    """Vectorized hierarchical grid contract.
+
+    Coordinates are (x, y) in the grid's CRS — lon/lat degrees for
+    geographic grids (H3), projected meters for BNG/CUSTOM.  Cell ids are
+    int64 (uint64 bit patterns stored in int64, as H3 does in Java).
+    """
+
+    #: short name used by IndexSystemFactory / conf strings
+    name: str = "ABSTRACT"
+    #: EPSG code of the grid CRS (4326 for H3, 27700 for BNG)
+    crs_id: int = 4326
+    #: True when cell ids have a canonical string form (BNG)
+    string_ids: bool = False
+
+    # ----------------------------------------------------------- metadata
+    @abc.abstractmethod
+    def resolutions(self) -> range:
+        """Supported resolution range (reference: IndexSystem.resolutions)."""
+
+    @abc.abstractmethod
+    def resolution_of(self, cells: np.ndarray) -> np.ndarray:
+        """[N] resolution of each cell id."""
+
+    # ------------------------------------------------------------ kernels
+    @abc.abstractmethod
+    def point_to_cell(self, xy: np.ndarray, res: int) -> np.ndarray:
+        """[N, 2] (x, y) -> [N] int64 cell ids (reference: pointToIndex)."""
+
+    @abc.abstractmethod
+    def cell_center(self, cells: np.ndarray) -> np.ndarray:
+        """[N] -> [N, 2] cell center (x, y)."""
+
+    @abc.abstractmethod
+    def cell_boundary(self, cells: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """[N] -> ([N, K, 2] vertices CCW, [N] vertex counts).
+
+        K is the grid's max boundary vertex count (4 rect, up to 10 for H3
+        cells crossing icosahedron edges).  Padded rows repeat the last
+        valid vertex.  (reference: indexToGeometry)"""
+
+    @abc.abstractmethod
+    def k_ring(self, cells: np.ndarray, k: int) -> np.ndarray:
+        """[N] -> [N, m] filled disk of radius k (id = -1 padding);
+        m = max disk size (3k²+3k+1 for hex).  (reference: kRing)"""
+
+    @abc.abstractmethod
+    def k_loop(self, cells: np.ndarray, k: int) -> np.ndarray:
+        """[N] -> [N, m] hollow ring at exactly distance k (-1 padding);
+        m = max ring size (6k for hex).  (reference: kLoop)"""
+
+    @abc.abstractmethod
+    def candidate_cells(self, bbox: np.ndarray, res: int,
+                        max_cells: int = 4_000_000) -> np.ndarray:
+        """All cells whose geometry may intersect bbox [xmin, ymin, xmax,
+        ymax]; a superset is allowed, the tessellation engine filters
+        exactly.  Replaces the reference's buffer-radius + polyfill
+        candidate generation (core/Mosaic.scala:61-99)."""
+
+    # ------------------------------------------------------- derived ops
+    def cell_area(self, cells: np.ndarray) -> np.ndarray:
+        """[N] planar area in CRS units² (reference: IndexSystem.area uses
+        spherical excess for geographic grids — H3 overrides with km²)."""
+        verts, counts = self.cell_boundary(cells)
+        x, y = verts[..., 0], verts[..., 1]
+        k = np.arange(verts.shape[1])[None, :]
+        valid = k < counts[:, None]
+        nxt = np.where(k + 1 >= counts[:, None], 0, k + 1)
+        x2 = np.take_along_axis(x, nxt, axis=1)
+        y2 = np.take_along_axis(y, nxt, axis=1)
+        tri = (x * y2 - x2 * y) * valid
+        return np.abs(0.5 * tri.sum(axis=-1))
+
+    def grid_distance(self, cells_a: np.ndarray,
+                      cells_b: np.ndarray) -> np.ndarray:
+        """[N] grid-step distance between paired cells (reference:
+        GridDistance expression).  Default: BFS-free approximation via
+        k_ring is grid-specific; subclasses override."""
+        raise NotImplementedError
+
+    def polyfill_centers(self, cells: np.ndarray) -> np.ndarray:
+        return self.cell_center(cells)
+
+    # ------------------------------------------------------ id formatting
+    def format_cell_id(self, cells: np.ndarray) -> list:
+        """int64 ids -> canonical string form (reference:
+        IndexSystem.format/formatCellId, :48-74)."""
+        return [format(int(c) & 0xFFFFFFFFFFFFFFFF, "x") for c in cells]
+
+    def parse_cell_id(self, strings) -> np.ndarray:
+        out = np.array([int(s, 16) for s in strings], dtype=np.uint64)
+        return out.view(np.int64)
+
+    # ---------------------------------------------------------- validity
+    def is_valid_cell(self, cells: np.ndarray) -> np.ndarray:
+        res = self.resolution_of(cells)
+        return (res >= self.resolutions().start) & \
+               (res < self.resolutions().stop)

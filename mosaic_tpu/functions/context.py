@@ -1,0 +1,414 @@
+"""MosaicContext — the user-facing function surface.
+
+Reference counterpart: functions/MosaicContext.scala:30-1091 (holds the
+(IndexSystem, GeometryAPI) pair; ``register`` wires ~150 SQL functions; the
+inner ``object functions`` is the typed DSL) and python/mosaic/api/*.py
+(thin py4j mirrors).  Here there is no JVM: the context binds the grid +
+config and exposes the same function names directly over columnar batches
+(GeometryArray / numpy / jax arrays).
+
+Naming matches the reference SQL surface 1:1 (st_*, grid_*, rst_*) so a
+Mosaic user can port call sites mechanically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import MosaicConfig, set_default_config
+from ..core.geometry import measures as _measures
+from ..core.geometry import predicates as _predicates
+from ..core.geometry.array import GeometryArray, GeometryBuilder, GeometryType
+from ..core.geometry.geojson import read_geojson, write_geojson
+from ..core.geometry.padded import build_edges, points_block
+from ..core.geometry.wkb import read_wkb, write_wkb
+from ..core.geometry.wkt import read_wkt, write_wkt
+from ..core.index.base import IndexSystem
+from ..core.index.factory import get_index_system
+from ..core.tessellate import point_chips, polyfill, tessellate
+from ..types import ChipSet
+
+Geoms = GeometryArray
+
+
+class MosaicContext:
+    """Bound (index system, geometry backend) + the function namespace."""
+
+    _instance: Optional["MosaicContext"] = None
+
+    def __init__(self, index_system: Union[str, IndexSystem] = "H3",
+                 geometry_api: str = "JAX"):
+        self.index_system = (index_system if isinstance(index_system,
+                                                        IndexSystem)
+                             else get_index_system(index_system))
+        self.geometry_api = geometry_api
+        self.config = MosaicConfig(
+            index_system=getattr(self.index_system, "name", "H3"),
+            geometry_api=geometry_api)
+
+    # reference: MosaicContext.build (functions/MosaicContext.scala:1110)
+    @classmethod
+    def build(cls, index_system: Union[str, IndexSystem] = "H3",
+              geometry_api: str = "JAX") -> "MosaicContext":
+        ctx = cls(index_system, geometry_api)
+        cls._instance = ctx
+        set_default_config(ctx.config)
+        return ctx
+
+    # reference: MosaicContext.context() (functions/MosaicContext.scala:1122)
+    @classmethod
+    def context(cls) -> "MosaicContext":
+        if cls._instance is None:
+            raise RuntimeError("MosaicContext not built yet — call "
+                               "mosaic_tpu.enable_mosaic() first")
+        return cls._instance
+
+    def function_names(self, group: Optional[str] = None) -> List[str]:
+        from .registry import function_names
+        return function_names(group)
+
+    # ------------------------------------------------------------------
+    # constructors / format converters
+    # (reference registrations: functions/MosaicContext.scala:212-276)
+    # ------------------------------------------------------------------
+    def st_geomfromwkt(self, wkts: Sequence[str]) -> Geoms:
+        return read_wkt(wkts)
+
+    st_geomfromtext = st_geomfromwkt
+
+    def st_geomfromwkb(self, blobs: Sequence[bytes]) -> Geoms:
+        return read_wkb(blobs)
+
+    st_geomfrombinary = st_geomfromwkb
+
+    def st_geomfromgeojson(self, texts: Sequence[str]) -> Geoms:
+        return read_geojson(texts)
+
+    def st_aswkt(self, g: Geoms) -> List[str]:
+        return write_wkt(g)
+
+    st_astext = st_aswkt
+
+    def st_aswkb(self, g: Geoms) -> List[bytes]:
+        return write_wkb(g)
+
+    st_asbinary = st_aswkb
+
+    def st_asgeojson(self, g: Geoms) -> List[str]:
+        return write_geojson(g)
+
+    def st_point(self, xs, ys) -> Geoms:
+        """reference: expressions/constructors/ST_Point.scala"""
+        xy = np.stack([np.asarray(xs, np.float64),
+                       np.asarray(ys, np.float64)], axis=-1)
+        return GeometryArray.from_points(xy)
+
+    def st_makeline(self, points: Sequence[Geoms]) -> Geoms:
+        """One LINESTRING per row from per-row point batches
+        (reference: ST_MakeLine)."""
+        b = GeometryBuilder()
+        for pa in points:
+            b.add_linestring(pa.coords[:, :2])
+        return b.finish()
+
+    def st_makepolygon(self, boundary: Geoms,
+                       holes: Optional[Sequence[Geoms]] = None) -> Geoms:
+        """LINESTRING ring(s) -> POLYGON (reference: ST_MakePolygon)."""
+        b = GeometryBuilder()
+        for i in range(len(boundary)):
+            _, parts = boundary.geom_slices(i)
+            shell = parts[0][0]
+            hole_rings = []
+            if holes is not None:
+                _, hparts = holes[i].geom_slices(0) if len(holes[i]) else \
+                    (None, [])
+                hole_rings = [r for p in hparts for r in p]
+            b.add_polygon(shell, hole_rings)
+        return b.finish()
+
+    # ------------------------------------------------------------------
+    # measures / accessors
+    # (reference registrations: functions/MosaicContext.scala:161-203)
+    # ------------------------------------------------------------------
+    def _edges(self, g: Geoms, dtype=np.float64):
+        return build_edges(g, dtype=dtype)
+
+    def st_area(self, g: Geoms) -> np.ndarray:
+        return np.asarray(_measures.area(self._edges(g)))
+
+    def st_length(self, g: Geoms) -> np.ndarray:
+        return np.asarray(_measures.length(self._edges(g)))
+
+    st_perimeter = st_length
+
+    def st_centroid(self, g: Geoms) -> Geoms:
+        c = np.asarray(_measures.centroid(self._edges(g)))
+        return GeometryArray.from_points(c, srid=g.srid)
+
+    def st_envelope(self, g: Geoms) -> Geoms:
+        bb = g.bboxes()
+        b = GeometryBuilder(srid=g.srid)
+        for xmin, ymin, xmax, ymax in bb:
+            b.add_polygon(np.array([[xmin, ymin], [xmax, ymin],
+                                    [xmax, ymax], [xmin, ymax],
+                                    [xmin, ymin]]))
+        return b.finish()
+
+    def st_xmin(self, g: Geoms) -> np.ndarray:
+        return g.bboxes()[:, 0]
+
+    def st_ymin(self, g: Geoms) -> np.ndarray:
+        return g.bboxes()[:, 1]
+
+    def st_xmax(self, g: Geoms) -> np.ndarray:
+        return g.bboxes()[:, 2]
+
+    def st_ymax(self, g: Geoms) -> np.ndarray:
+        return g.bboxes()[:, 3]
+
+    def st_zmin(self, g: Geoms) -> np.ndarray:
+        return self._z_agg(g, np.minimum.reduceat)
+
+    def st_zmax(self, g: Geoms) -> np.ndarray:
+        return self._z_agg(g, np.maximum.reduceat)
+
+    def _z_agg(self, g: Geoms, reduceat) -> np.ndarray:
+        if g.ndim < 3:
+            return np.full(len(g), np.nan)
+        starts = g.vertex_starts()
+        z = g.coords[:, 2]
+        out = reduceat(z, np.minimum(starts[:-1], len(z) - 1))
+        return np.where(g.vertex_counts() > 0, out[:len(g)], np.nan)
+
+    def st_x(self, g: Geoms) -> np.ndarray:
+        return np.asarray(points_block(g, dtype=np.float64))[:, 0]
+
+    def st_y(self, g: Geoms) -> np.ndarray:
+        return np.asarray(points_block(g, dtype=np.float64))[:, 1]
+
+    def st_z(self, g: Geoms) -> np.ndarray:
+        if g.ndim < 3:
+            return np.full(len(g), np.nan)
+        starts = g.vertex_starts()[:-1]
+        return g.coords[np.minimum(starts, len(g.coords) - 1), 2]
+
+    def st_numpoints(self, g: Geoms) -> np.ndarray:
+        return g.vertex_counts()
+
+    def st_dimension(self, g: Geoms) -> np.ndarray:
+        dims = {1: 0, 4: 0, 2: 1, 5: 1, 3: 2, 6: 2, 7: 2}
+        return np.asarray([dims[int(t)] for t in g.types])
+
+    def st_geometrytype(self, g: Geoms) -> List[str]:
+        return [GeometryType(int(t)).wkt_name for t in g.types]
+
+    def st_srid(self, g: Geoms) -> int:
+        return g.srid
+
+    def st_setsrid(self, g: Geoms, srid: int) -> Geoms:
+        import dataclasses as _dc
+        return _dc.replace(g, srid=srid)
+
+    def st_haversine(self, lat1, lng1, lat2, lng2) -> np.ndarray:
+        return np.asarray(_measures.haversine(lat1, lng1, lat2, lng2))
+
+    def st_distance(self, a: Geoms, b: Geoms) -> np.ndarray:
+        """Pairwise (row i vs row i) planar distance (reference:
+        ST_Distance).  Points inside polygons get distance 0."""
+        ea, eb = self._edges(a), self._edges(b)
+        if np.all(a.types == GeometryType.POINT):
+            pts = np.asarray(points_block(a, dtype=np.float64))
+            d = np.asarray(_measures.distance_points_to_geoms(pts, eb))
+            d = np.diagonal(d).copy()
+            inside, _ = _predicates.points_in_polygons(pts, eb)
+            d[np.asarray(inside).diagonal()] = 0.0
+            return d
+        # general: min over vertex-to-edge distances both directions
+        pa = a.coords[:, :2]
+        pb = b.coords[:, :2]
+        da = np.asarray(_measures.distance_points_to_geoms(
+            np.asarray(pa), eb))      # [Va, Gb]
+        db = np.asarray(_measures.distance_points_to_geoms(
+            np.asarray(pb), ea))      # [Vb, Ga]
+        ga = a.vertex_geom_ids()
+        gb = b.vertex_geom_ids()
+        out = np.full(len(a), np.inf)
+        for i in range(len(a)):
+            d1 = da[ga == i, i].min(initial=np.inf)
+            d2 = db[gb == i, i].min(initial=np.inf)
+            out[i] = min(d1, d2)
+        return out
+
+    # ------------------------------------------------------------------
+    # predicates
+    # ------------------------------------------------------------------
+    def st_contains(self, a: Geoms, b: Geoms) -> np.ndarray:
+        """Row-wise a contains b.  Point-in-polygon fast path when b is
+        all points (reference: ST_Contains)."""
+        ea = self._edges(a)
+        if np.all(b.types == GeometryType.POINT):
+            pts = np.asarray(points_block(b, dtype=np.float64))
+            inside, _ = _predicates.points_in_polygons(pts, ea)
+            return np.asarray(inside).diagonal().copy()
+        eb = self._edges(b)
+        return np.asarray(
+            _predicates.polygon_contains_polygon(ea, eb)).diagonal().copy()
+
+    def st_within(self, a: Geoms, b: Geoms) -> np.ndarray:
+        return self.st_contains(b, a)
+
+    def st_intersects(self, a: Geoms, b: Geoms) -> np.ndarray:
+        ea, eb = self._edges(a), self._edges(b)
+        return np.asarray(
+            _predicates.polygons_intersect(ea, eb)).diagonal().copy()
+
+    # ------------------------------------------------------------------
+    # affine transforms
+    # ------------------------------------------------------------------
+    def st_translate(self, g: Geoms, dx: float, dy: float) -> Geoms:
+        import dataclasses as _dc
+        c = g.coords.copy()
+        c[:, 0] += dx
+        c[:, 1] += dy
+        return _dc.replace(g, coords=c)
+
+    def st_scale(self, g: Geoms, sx: float, sy: float) -> Geoms:
+        import dataclasses as _dc
+        c = g.coords.copy()
+        c[:, 0] *= sx
+        c[:, 1] *= sy
+        return _dc.replace(g, coords=c)
+
+    def st_rotate(self, g: Geoms, theta: float) -> Geoms:
+        import dataclasses as _dc
+        c = g.coords.copy()
+        x, y = c[:, 0].copy(), c[:, 1].copy()
+        c[:, 0] = x * np.cos(theta) - y * np.sin(theta)
+        c[:, 1] = x * np.sin(theta) + y * np.cos(theta)
+        return _dc.replace(g, coords=c)
+
+    def st_dump(self, g: Geoms) -> Geoms:
+        """Explode multi-geometries into singles (reference:
+        FlattenPolygons / st_dump)."""
+        b = GeometryBuilder(ndim=g.ndim, srid=g.srid)
+        single = {4: GeometryType.POINT, 5: GeometryType.LINESTRING,
+                  6: GeometryType.POLYGON}
+        for i in range(len(g)):
+            t, parts = g.geom_slices(i)
+            if int(t) in single:
+                for p in parts:
+                    b.add(single[int(t)], [p])
+            elif t == GeometryType.GEOMETRYCOLLECTION:
+                from ..core.geometry.wkb import _infer_part_type
+                for p in parts:
+                    b.add(_infer_part_type(p), [p])
+            else:
+                b.add(t, parts)
+        return b.finish()
+
+    # ------------------------------------------------------------------
+    # grid functions
+    # (reference registrations: functions/MosaicContext.scala:399-529)
+    # ------------------------------------------------------------------
+    def grid_longlatascellid(self, lons, lats, res: int) -> np.ndarray:
+        xy = np.stack([np.asarray(lons, np.float64),
+                       np.asarray(lats, np.float64)], axis=-1)
+        return self.index_system.point_to_cell(xy, res)
+
+    def grid_pointascellid(self, g: Geoms, res: int) -> np.ndarray:
+        pts = np.asarray(points_block(g, dtype=np.float64))
+        return self.index_system.point_to_cell(pts, res)
+
+    def grid_polyfill(self, g: Geoms, res: int) -> List[np.ndarray]:
+        return polyfill(g, res, self.index_system)
+
+    def grid_tessellate(self, g: Geoms, res: int,
+                        keep_core_geom: bool = True) -> ChipSet:
+        return tessellate(g, res, self.index_system, keep_core_geom)
+
+    grid_tessellateexplode = grid_tessellate
+    mosaic_explode = grid_tessellate          # legacy alias (:549-557)
+    mosaicfill = grid_tessellate
+
+    def grid_boundary(self, cells) -> Geoms:
+        verts, counts = self.index_system.cell_boundary(
+            np.asarray(cells, np.int64))
+        b = GeometryBuilder()
+        for i in range(len(counts)):
+            ring = verts[i, :counts[i]]
+            b.add_polygon(np.vstack([ring, ring[:1]]))
+        return b.finish()
+
+    def grid_boundaryaswkb(self, cells) -> List[bytes]:
+        return write_wkb(self.grid_boundary(cells))
+
+    def grid_cellarea(self, cells) -> np.ndarray:
+        return self.index_system.cell_area(np.asarray(cells, np.int64))
+
+    def grid_cellkring(self, cells, k: int) -> np.ndarray:
+        return self.index_system.k_ring(np.asarray(cells, np.int64), k)
+
+    def grid_cellkloop(self, cells, k: int) -> np.ndarray:
+        return self.index_system.k_loop(np.asarray(cells, np.int64), k)
+
+    def grid_cellkringexplode(self, cells, k: int):
+        ring = self.grid_cellkring(cells, k)
+        src = np.repeat(np.arange(len(ring)), ring.shape[1])
+        flat = ring.ravel()
+        keep = flat >= 0
+        return src[keep], flat[keep]
+
+    def grid_cellkloopexplode(self, cells, k: int):
+        loop = self.grid_cellkloop(cells, k)
+        src = np.repeat(np.arange(len(loop)), loop.shape[1])
+        flat = loop.ravel()
+        keep = flat >= 0
+        return src[keep], flat[keep]
+
+    def grid_geometrykring(self, g: Geoms, res: int, k: int) -> List[np.ndarray]:
+        """k-ring of the cell set touching each geometry (reference:
+        GeometryKRing; core/Mosaic.scala:123)."""
+        out = []
+        chips = tessellate(g, res, self.index_system, keep_core_geom=False)
+        for i in range(len(g)):
+            cells = chips.cell_id[chips.geom_id == i]
+            if len(cells) == 0:
+                out.append(np.empty(0, np.int64))
+                continue
+            rings = self.index_system.k_ring(cells, k)
+            flat = rings.ravel()
+            out.append(np.unique(flat[flat >= 0]))
+        return out
+
+    def grid_geometrykloop(self, g: Geoms, res: int, k: int) -> List[np.ndarray]:
+        """Hollow ring: geometry k-ring minus (k-1)-ring (reference:
+        GeometryKLoop, core/Mosaic.scala:142)."""
+        outer = self.grid_geometrykring(g, res, k)
+        inner = self.grid_geometrykring(g, res, k - 1) if k > 1 else \
+            [c for c in self.grid_polyfill_union(g, res)]
+        return [np.setdiff1d(o, i) for o, i in zip(outer, inner)]
+
+    def grid_polyfill_union(self, g: Geoms, res: int) -> List[np.ndarray]:
+        chips = tessellate(g, res, self.index_system, keep_core_geom=False)
+        return [np.unique(chips.cell_id[chips.geom_id == i])
+                for i in range(len(g))]
+
+    def grid_distance(self, cells_a, cells_b) -> np.ndarray:
+        return self.index_system.grid_distance(
+            np.asarray(cells_a, np.int64), np.asarray(cells_b, np.int64))
+
+    def grid_wrapaschip(self, cells, is_core: bool = True) -> ChipSet:
+        """Wrap plain cell ids as chips (reference:
+        MosaicContext.scala:1012-1019)."""
+        cells = np.asarray(cells, np.int64)
+        return ChipSet(np.arange(len(cells)), cells,
+                       np.full(len(cells), is_core), self.grid_boundary(cells))
+
+    # id formatting (reference: IndexSystem.formatCellId :48-74)
+    def grid_cellid_to_string(self, cells) -> List[str]:
+        return self.index_system.format_cell_id(np.asarray(cells, np.int64))
+
+    def grid_cellid_from_string(self, strings) -> np.ndarray:
+        return self.index_system.parse_cell_id(strings)

@@ -1,0 +1,27 @@
+"""Test harness: force a virtual 8-device CPU mesh before JAX imports.
+
+Mirrors the reference's local-cluster distribution testing
+(test/SparkSuite.scala:8-50 spins local[4]): no real pod, but the sharding
+/ collective paths are exercised for real across 8 XLA host devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,153 @@
+"""MosaicContext function-surface tests (reference: python/test/
+test_vector_functions.py shape: call every function once on small data)."""
+
+import numpy as np
+import pytest
+
+import mosaic_tpu as mos
+from mosaic_tpu.functions.context import MosaicContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MosaicContext.build("CUSTOM(0,16,0,16,2,1,1)")
+
+
+def test_enable_and_context(ctx):
+    assert MosaicContext.context() is ctx
+    c2 = mos.enable_mosaic("CUSTOM(0,16,0,16,2,1,1)")
+    assert MosaicContext.context() is c2
+
+
+def test_constructors(ctx):
+    g = ctx.st_point([1.0, 2.0], [3.0, 4.0])
+    assert len(g) == 2
+    assert ctx.st_aswkt(g)[0] == "POINT (1 3)"
+    g2 = ctx.st_geomfromwkt(["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"])
+    assert ctx.st_geometrytype(g2) == ["POLYGON"]
+    blobs = ctx.st_aswkb(g2)
+    g3 = ctx.st_geomfromwkb(blobs)
+    assert np.allclose(g2.coords, g3.coords)
+    js = ctx.st_asgeojson(g2)
+    g4 = ctx.st_geomfromgeojson(js)
+    assert np.allclose(g2.coords, g4.coords)
+
+
+def test_measures(ctx):
+    g = ctx.st_geomfromwkt(["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"])
+    assert ctx.st_area(g)[0] == pytest.approx(16.0)
+    assert ctx.st_perimeter(g)[0] == pytest.approx(16.0)
+    assert ctx.st_xmin(g)[0] == 0 and ctx.st_xmax(g)[0] == 4
+    assert ctx.st_numpoints(g)[0] == 5
+    assert ctx.st_dimension(g)[0] == 2
+    c = ctx.st_centroid(g)
+    assert ctx.st_x(c)[0] == pytest.approx(2.0)
+    env = ctx.st_envelope(ctx.st_geomfromwkt(["LINESTRING (1 2, 5 7)"]))
+    assert ctx.st_area(env)[0] == pytest.approx(20.0)
+
+
+def test_predicates_and_distance(ctx):
+    polys = ctx.st_geomfromwkt(["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"])
+    pts = ctx.st_point([2.0], [2.0])
+    assert ctx.st_contains(polys, pts)[0]
+    assert ctx.st_within(pts, polys)[0]
+    d = ctx.st_distance(ctx.st_point([6.0], [2.0]), polys)
+    assert d[0] == pytest.approx(2.0)
+    assert ctx.st_distance(pts, polys)[0] == 0.0
+    a = ctx.st_geomfromwkt(["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"])
+    b = ctx.st_geomfromwkt(["POLYGON ((2 2, 6 2, 6 6, 2 6, 2 2))"])
+    assert ctx.st_intersects(a, b)[0]
+
+
+def test_affine(ctx):
+    g = ctx.st_point([1.0], [2.0])
+    t = ctx.st_translate(g, 10, 20)
+    assert ctx.st_x(t)[0] == 11 and ctx.st_y(t)[0] == 22
+    s = ctx.st_scale(g, 2, 3)
+    assert ctx.st_x(s)[0] == 2 and ctx.st_y(s)[0] == 6
+    r = ctx.st_rotate(g, np.pi / 2)
+    assert ctx.st_x(r)[0] == pytest.approx(-2.0)
+    assert ctx.st_y(r)[0] == pytest.approx(1.0)
+
+
+def test_dump(ctx):
+    g = ctx.st_geomfromwkt(
+        ["MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), "
+         "((5 5, 6 5, 6 6, 5 6, 5 5)))"])
+    d = ctx.st_dump(g)
+    assert len(d) == 2
+    assert ctx.st_geometrytype(d) == ["POLYGON", "POLYGON"]
+
+
+def test_grid_functions(ctx):
+    cells = ctx.grid_longlatascellid([1.5, 2.5], [3.5, 4.5], 0)
+    assert len(cells) == 2
+    pts = ctx.st_point([1.5], [3.5])
+    assert ctx.grid_pointascellid(pts, 0)[0] == cells[0]
+    b = ctx.grid_boundary(cells)
+    assert ctx.st_area(b)[0] == pytest.approx(1.0)
+    wkbs = ctx.grid_boundaryaswkb(cells)
+    assert len(wkbs) == 2
+    assert ctx.grid_cellarea(cells)[0] == pytest.approx(1.0)
+    src, ring = ctx.grid_cellkringexplode(cells, 1)
+    assert set(src.tolist()) == {0, 1}
+    g = ctx.st_geomfromwkt(["POLYGON ((1.2 1.2, 3.2 1.2, 3.2 3.2, 1.2 3.2,"
+                            " 1.2 1.2))"])
+    pf = ctx.grid_polyfill(g, 0)
+    assert len(pf[0]) == 4
+    chips = ctx.grid_tessellate(g, 0)
+    assert len(chips) > 4
+    kr = ctx.grid_geometrykring(g, 0, 1)
+    assert len(kr[0]) > len(ctx.grid_polyfill_union(g, 0)[0])
+    kl = ctx.grid_geometrykloop(g, 0, 1)
+    assert len(np.intersect1d(kl[0], ctx.grid_polyfill_union(g, 0)[0])) == 0
+    s = ctx.grid_cellid_to_string(cells)
+    assert np.array_equal(ctx.grid_cellid_from_string(s), cells)
+
+
+def test_multipoint_multicell_chips(ctx):
+    g = ctx.st_geomfromwkt(["MULTIPOINT ((3.1 3.1), (3.2 3.2), (9.5 9.5))"])
+    chips = ctx.grid_tessellate(g, 0)
+    assert len(chips) == 2
+    nv = chips.geoms.vertex_counts()
+    assert sorted(nv.tolist()) == [1, 2]  # two co-celled points kept
+
+
+def test_hole_inside_single_cell_not_core(ctx):
+    """Regression: a hole strictly inside one cell must make that cell a
+    border chip (with the hole), not core."""
+    g = ctx.st_geomfromwkt([
+        "POLYGON ((0.5 0.5, 7.5 0.5, 7.5 7.5, 0.5 7.5, 0.5 0.5),"
+        " (4.3 4.3, 4.7 4.3, 4.7 4.7, 4.3 4.7, 4.3 4.3))"])
+    chips = ctx.grid_tessellate(g, 0)
+    cell = ctx.index_system.point_to_cell(np.array([[4.5, 4.5]]), 0)[0]
+    k = np.nonzero(chips.cell_id == cell)[0]
+    assert len(k) == 1 and not chips.is_core[k[0]]
+    # the chip must exclude the hole: point inside the hole not contained
+    from mosaic_tpu.core.tessellate import _pip, _poly_edges
+    chip_edges = _poly_edges(chips.geoms, int(k[0]))
+    assert not _pip(np.array([[4.5, 4.5]]), chip_edges)[0]
+    assert _pip(np.array([[4.1, 4.1]]), chip_edges)[0]
+
+
+def test_multipolygon_part_inside_cell(ctx):
+    """Regression: a whole multipolygon part swallowed by one cell whose
+    center is outside the part must still produce a chip."""
+    g = ctx.st_geomfromwkt([
+        "MULTIPOLYGON (((0.5 0.5, 1.5 0.5, 1.5 1.5, 0.5 1.5, 0.5 0.5)),"
+        " ((2.05 2.05, 2.2 2.05, 2.2 2.2, 2.05 2.2, 2.05 2.05)))"])
+    chips = ctx.grid_tessellate(g, 0)
+    cell = ctx.index_system.point_to_cell(np.array([[2.1, 2.1]]), 0)[0]
+    k = np.nonzero(chips.cell_id == cell)[0]
+    assert len(k) == 1
+    from mosaic_tpu.core.tessellate import _pip, _poly_edges
+    chip_edges = _poly_edges(chips.geoms, int(k[0]))
+    assert _pip(np.array([[2.1, 2.1]]), chip_edges)[0]
+    assert not _pip(np.array([[2.5, 2.5]]), chip_edges)[0]
+
+
+def test_empty_point_wkt_roundtrip(ctx):
+    g = ctx.st_geomfromwkt(["POINT EMPTY"])
+    blobs = ctx.st_aswkb(g)
+    g2 = ctx.st_geomfromwkb(blobs)
+    assert ctx.st_aswkt(g2) == ["POINT EMPTY"]
