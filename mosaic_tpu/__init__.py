@@ -15,6 +15,15 @@ Entry point mirrors the reference (python/mosaic/api/enable.py:15):
     cells = ctx.grid_longlatascellid(lons, lats, 9)
 """
 
+import jax as _jax
+
+# Cell ids are int64 bit patterns (H3 reserves the high bits;
+# core/index/IndexSystem.scala stores Long ids) — 64-bit integer support is
+# a hard requirement, not a preference.  Device float compute stays float32
+# throughout (every kernel requests its dtype explicitly), so this does not
+# push f64 matmuls onto the MXU.
+_jax.config.update("jax_enable_x64", True)
+
 from .config import MosaicConfig, default_config, set_default_config
 from .core.geometry.array import GeometryArray, GeometryBuilder, GeometryType
 from .core.geometry.wkb import read_wkb, write_wkb

@@ -1,0 +1,78 @@
+"""Synthetic benchmark workloads.
+
+The headline workload mirrors the reference Quickstart
+(notebooks/examples/python/Quickstart/QuickstartNotebook.ipynb): a
+point×polygon PIP join over a city-scale zone partition — NYC taxi pickups
+× ~300 taxi zones (BASELINE.md config 1).  With zero egress the real
+parquet/GeoJSON inputs aren't available, so we generate a statistically
+similar stand-in: a jittered-lattice planar partition of the NYC bbox
+(convex quad "zones", same count/size regime as taxi zones) and uniform
+pickup points.  Exactness is still checked against the float64 host path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..core.geometry.array import GeometryArray, GeometryBuilder
+from ..core.index.base import IndexSystem
+from ..core.index.custom import CustomIndexSystem, GridConf
+
+# NYC-ish bbox (lon/lat)
+NYC = (-74.30, 40.45, -73.65, 40.95)
+
+
+def nyc_zones(n_side: int = 16, seed: int = 7,
+              bbox: Tuple[float, float, float, float] = NYC
+              ) -> GeometryArray:
+    """A planar partition of ``bbox`` into n_side² convex quads (jittered
+    lattice) — the taxi-zone stand-in."""
+    rng = np.random.default_rng(seed)
+    xs = np.linspace(bbox[0], bbox[2], n_side + 1)
+    ys = np.linspace(bbox[1], bbox[3], n_side + 1)
+    gx, gy = np.meshgrid(xs, ys, indexing="ij")
+    jx = (xs[1] - xs[0]) * 0.30
+    jy = (ys[1] - ys[0]) * 0.30
+    nodes = np.stack([gx, gy], axis=-1)
+    jitter = rng.uniform(-1, 1, nodes.shape) * np.array([jx, jy])
+    jitter[0, :, 0] = jitter[-1, :, 0] = 0.0
+    jitter[:, 0, 1] = jitter[:, -1, 1] = 0.0
+    nodes = nodes + jitter
+    b = GeometryBuilder()
+    for i in range(n_side):
+        for j in range(n_side):
+            ring = np.array([nodes[i, j], nodes[i + 1, j],
+                             nodes[i + 1, j + 1], nodes[i, j + 1],
+                             nodes[i, j]])
+            b.add_polygon(ring)
+    return b.finish()
+
+
+def nyc_points(n: int, seed: int = 11,
+               bbox: Tuple[float, float, float, float] = NYC) -> np.ndarray:
+    """[n, 2] float64 uniform points over the bbox (pickups stand-in)."""
+    rng = np.random.default_rng(seed)
+    return np.stack([rng.uniform(bbox[0], bbox[2], n),
+                     rng.uniform(bbox[1], bbox[3], n)], axis=-1)
+
+
+def nyc_grid(res_cells: int = 512,
+             bbox: Tuple[float, float, float, float] = NYC
+             ) -> Tuple[IndexSystem, int]:
+    """A rectangular grid over the bbox whose finest listed resolution has
+    ``res_cells`` cells per axis — cell size comparable to H3 res 9 over a
+    city (~175 m).  Swapped for H3IndexSystem once its device kernel lands.
+    """
+    splits = 2
+    res = int(np.round(np.log2(res_cells)))
+    return CustomIndexSystem(GridConf(
+        bbox[0], bbox[2], bbox[1], bbox[3], splits,
+        (bbox[2] - bbox[0]), (bbox[3] - bbox[1]), 4326)), res
+
+
+def build_workload(n_side: int = 16, res_cells: int = 512):
+    """(polys, grid, res) for the PIP-join benchmark."""
+    grid, res = nyc_grid(res_cells)
+    return nyc_zones(n_side), grid, res

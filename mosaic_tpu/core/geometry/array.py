@@ -132,6 +132,36 @@ class GeometryArray:
             types=np.full(n, GeometryType.POINT, np.uint8), srid=srid)
 
     @staticmethod
+    def from_padded_polygons(verts: np.ndarray, counts: np.ndarray,
+                             srid: int = 4326) -> "GeometryArray":
+        """Vectorized batch of simple polygons from padded rings.
+
+        verts [M, K, 2] (CCW, padded), counts [M] valid vertex counts.
+        Rings are closed (first vertex appended).  This is the fast path
+        for turning grid-cell boundaries into polygon batches."""
+        verts = np.asarray(verts, np.float64)
+        counts = np.asarray(counts, np.int64)
+        m, k = verts.shape[:2]
+        if m == 0:
+            return GeometryArray.empty(2, srid)
+        flat = verts.reshape(-1, 2)
+        lens = counts
+        firsts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        main_idx = np.arange(int(lens.sum()), dtype=np.int64) + \
+            np.repeat(np.arange(m, dtype=np.int64) * k - firsts, lens)
+        ring_id = np.repeat(np.arange(m), lens)
+        out_off = np.concatenate([[0], np.cumsum(counts + 1)]).astype(
+            np.int64)
+        out = np.empty(out_off[-1], np.int64)
+        out[np.arange(len(main_idx)) + ring_id] = main_idx
+        out[out_off[1:] - 1] = np.arange(m, dtype=np.int64) * k
+        ar = np.arange(m + 1, dtype=np.int64)
+        return GeometryArray(
+            coords=flat[out], ring_offsets=out_off, part_offsets=ar,
+            geom_offsets=ar,
+            types=np.full(m, GeometryType.POLYGON, np.uint8), srid=srid)
+
+    @staticmethod
     def concat(arrays: Sequence["GeometryArray"]) -> "GeometryArray":
         arrays = [a for a in arrays if len(a) > 0] or [GeometryArray.empty()]
         ndim = max(a.ndim for a in arrays)
@@ -166,13 +196,39 @@ class GeometryArray:
         return t, parts
 
     def take(self, idx) -> "GeometryArray":
-        """Gather a subset of geometries (host-side)."""
+        """Gather/permute a subset of geometries — vectorized offset
+        arithmetic, no per-geometry Python work."""
         idx = np.asarray(idx, dtype=np.int64).reshape(-1)
-        builder = GeometryBuilder(ndim=self.ndim, srid=self.srid)
-        for i in idx:
-            t, parts = self.geom_slices(int(i))
-            builder.add(t, parts)
-        return builder.finish()
+        if len(idx) == 0:
+            return GeometryArray.empty(self.ndim, self.srid)
+
+        def expand(starts, stops):
+            """Concatenate aranges [starts[i], stops[i]) without a loop."""
+            lens = (stops - starts).astype(np.int64)
+            total = int(lens.sum())
+            if total == 0:
+                return np.zeros(0, np.int64), lens
+            firsts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+            out = np.arange(total, dtype=np.int64) + \
+                np.repeat(starts - firsts, lens)
+            return out, lens
+
+        p_idx, parts_per_geom = expand(self.geom_offsets[idx],
+                                       self.geom_offsets[idx + 1])
+        r_idx, rings_per_part = expand(self.part_offsets[p_idx],
+                                       self.part_offsets[p_idx + 1])
+        v_idx, verts_per_ring = expand(self.ring_offsets[r_idx],
+                                       self.ring_offsets[r_idx + 1])
+        ring_offsets = np.concatenate(
+            [[0], np.cumsum(verts_per_ring)]).astype(np.int64)
+        part_offsets = np.concatenate(
+            [[0], np.cumsum(rings_per_part)]).astype(np.int64)
+        geom_offsets = np.concatenate(
+            [[0], np.cumsum(parts_per_geom)]).astype(np.int64)
+        return GeometryArray(
+            coords=self.coords[v_idx], ring_offsets=ring_offsets,
+            part_offsets=part_offsets, geom_offsets=geom_offsets,
+            types=self.types[idx], srid=self.srid)
 
     def __getitem__(self, i) -> "GeometryArray":
         if isinstance(i, (int, np.integer)):

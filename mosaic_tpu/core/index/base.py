@@ -50,6 +50,21 @@ class IndexSystem(abc.ABC):
     def point_to_cell(self, xy: np.ndarray, res: int) -> np.ndarray:
         """[N, 2] (x, y) -> [N] int64 cell ids (reference: pointToIndex)."""
 
+    def point_to_cell_jax(self, xy, res: int):
+        """jax-traceable point_to_cell: [N, 2] -> [N] int64, safe to call
+        inside jit/shard_map.  Device-side cell assignment is the first
+        stage of every indexed join; grids implement it as closed-form
+        bit/float math (no tables beyond small constant gathers)."""
+        raise NotImplementedError(f"{self.name} has no device kernel")
+
+    def point_in_bounds_jax(self, xy):
+        """jax-traceable [N, 2] -> [N] bool: point lies inside the grid's
+        valid domain.  Global grids (H3) cover the sphere and return all
+        True; bounded grids (CUSTOM/BNG) must override so out-of-domain
+        points are rejected rather than clipped into a boundary cell."""
+        import jax.numpy as jnp
+        return jnp.ones(xy.shape[:-1], bool)
+
     @abc.abstractmethod
     def cell_center(self, cells: np.ndarray) -> np.ndarray:
         """[N] -> [N, 2] cell center (x, y)."""

@@ -110,6 +110,29 @@ class CustomIndexSystem(IndexSystem):
         iy = np.clip(iy, 0, self.cells_per_axis_y(res) - 1)
         return self._pack(res, ix, iy)
 
+    def point_in_bounds_jax(self, xy):
+        import jax.numpy as jnp
+        c = self.conf
+        return ((xy[..., 0] >= c.bound_x_min) & (xy[..., 0] <= c.bound_x_max)
+                & (xy[..., 1] >= c.bound_y_min)
+                & (xy[..., 1] <= c.bound_y_max))
+
+    def point_to_cell_jax(self, xy, res: int):
+        import jax
+        import jax.numpy as jnp
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "mosaic_tpu cell ids are int64 bit patterns; "
+                "jax_enable_x64 must be on (import mosaic_tpu enables it)")
+        self._check_res(res)
+        c = self.conf
+        sx, sy = self.cell_size(res)
+        ix = jnp.floor((xy[..., 0] - c.bound_x_min) / sx).astype(jnp.int64)
+        iy = jnp.floor((xy[..., 1] - c.bound_y_min) / sy).astype(jnp.int64)
+        ix = jnp.clip(ix, 0, self.cells_per_axis_x(res) - 1)
+        iy = jnp.clip(iy, 0, self.cells_per_axis_y(res) - 1)
+        return (jnp.int64(res) << _RES_SHIFT) | (iy << _Y_SHIFT) | ix
+
     def cell_center(self, cells: np.ndarray) -> np.ndarray:
         res, ix, iy = self._unpack(cells)
         c = self.conf

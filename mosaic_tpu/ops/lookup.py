@@ -1,0 +1,47 @@
+"""Device-side sorted-table lookups.
+
+The reference's PIP join is a Spark hash-exchange equi-join on cell id
+(SURVEY.md P2/P3; Quickstart join on ``pickup_h3 == mosaic_index.index_id``).
+On TPU the broadcast side (the tessellated polygon index) is a sorted int64
+table resident in HBM and the "join" is a vectorized binary search — a
+handful of gathers per point, no hashing, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def searchsorted(table: jnp.ndarray, keys: jnp.ndarray) -> jnp.ndarray:
+    """Branchless binary search: first index where table[i] >= key.
+
+    table [T] sorted int64, keys [...] int64 -> [...] int32 in [0, T].
+    Unrolled to ceil(log2(T)) steps — static shapes, no while_loop, so XLA
+    fuses it with the surrounding gather/compare work.
+    """
+    t = table.shape[0]
+    if t == 0:
+        return jnp.zeros(keys.shape, jnp.int32)
+    lo = jnp.zeros(keys.shape, jnp.int32)
+    hi = jnp.full(keys.shape, t, jnp.int32)
+    steps = max(1, t.bit_length())
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        v = table[jnp.clip(mid, 0, t - 1)]
+        active = lo < hi
+        go_right = active & (v < keys)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def lookup(table: jnp.ndarray, keys: jnp.ndarray):
+    """(index, found) of each key in a sorted table (exact match)."""
+    if table.shape[0] == 0:
+        return (jnp.zeros(keys.shape, jnp.int32),
+                jnp.zeros(keys.shape, bool))
+    idx = searchsorted(table, keys)
+    safe = jnp.clip(idx, 0, table.shape[0] - 1)
+    found = table[safe] == keys
+    return safe, found

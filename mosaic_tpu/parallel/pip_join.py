@@ -1,0 +1,299 @@
+"""The index-accelerated point-in-polygon join — the flagship pipeline.
+
+Reference counterpart: the Quickstart workload
+(notebooks/examples/python/Quickstart/QuickstartNotebook.ipynb): points get
+``grid_pointascellid``, polygons get ``grid_tessellateexplode``, Spark
+equi-joins on cell id, then filters ``is_core OR st_contains(chip, point)``.
+
+TPU-first redesign: the tessellated polygon side becomes a device-resident
+sorted cell-id table (core cells + border cells with padded chip edge
+blocks).  The per-point pipeline is one fused XLA computation:
+
+    cell   = grid.point_to_cell_jax(points)          # closed-form bit math
+    islot  = binary-search cell in core/border table # ops.lookup
+    inside = crossing-parity vs the <=D chips in the cell
+    zone   = core hit ? core zone : first chip hit
+
+No shuffle is needed while the polygon side fits in HBM (the reference's
+broadcast-join regime; ~300 taxi zones → a few MB of chips).  Points shard
+over the mesh's data axis via jax.sharding; the table replicates.  For
+polygon×polygon joins both sides shard — see overlay.py (cell-bucketed
+all_to_all).
+
+Precision: device compute is float32; points whose distance to a chip
+boundary is below ``eps`` are flagged and re-checked on host in float64
+against the same chips, so results match the exact host path
+(config.MosaicConfig.exact_fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.geometry.array import GeometryArray
+from ..core.geometry.padded import build_edges
+from ..core.index.base import IndexSystem
+from ..core.tessellate import tessellate
+from ..ops.lookup import lookup
+from ..types import ChipSet
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PIPIndex:
+    """Device-resident tessellation index of a polygon batch.
+
+    core_cells   [C]        sorted cell ids fully inside some polygon
+    core_zone    [C]        polygon id per core cell
+    border_cells [B]        sorted cell ids on some polygon's boundary
+                            (duplicates allowed: one entry per chip)
+    border_zone  [B]        polygon id per chip
+    chip_a/b     [B, E, 2]  chip edges (float32)
+    chip_mask    [B, E]
+    max_dup      static     max chips sharing one cell id (probe width)
+    res          static     grid resolution
+    """
+
+    core_cells: jnp.ndarray
+    core_zone: jnp.ndarray
+    border_cells: jnp.ndarray
+    border_zone: jnp.ndarray
+    chip_a: jnp.ndarray
+    chip_b: jnp.ndarray
+    chip_mask: jnp.ndarray
+    max_dup: int
+    res: int
+
+    def tree_flatten(self):
+        return ((self.core_cells, self.core_zone, self.border_cells,
+                 self.border_zone, self.chip_a, self.chip_b, self.chip_mask),
+                (self.max_dup, self.res))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    @property
+    def num_chips(self) -> int:
+        return self.border_cells.shape[0]
+
+
+def _unsafe_core_mask(core_cells: np.ndarray, core_zone: np.ndarray,
+                      grid: IndexSystem) -> np.ndarray:
+    """Core cells that abut a core cell of a DIFFERENT zone.
+
+    The device assigns cells in float32; a point within ~1 ulp of a cell
+    edge can land in the neighboring cell.  That is harmless when the
+    neighbor resolves through chip tests (the eps band flags it) or is core
+    of the same zone — the one silent-corruption case is two different
+    zones' core cells sharing an edge (zone boundary exactly on the grid).
+    Those cells are demoted to full-cell border chips at build time so the
+    hazard funnels through the chip eps machinery; the fast core path then
+    never answers wrongly."""
+    if len(core_cells) == 0:
+        return np.zeros(0, bool)
+    order = np.argsort(core_cells, kind="stable")
+    sc, sz = core_cells[order], core_zone[order]
+    ring = grid.k_ring(core_cells, 1)                       # [C, m]
+    pos = np.clip(np.searchsorted(sc, ring), 0, len(sc) - 1)
+    found = (sc[pos] == ring) & (ring >= 0)
+    return np.any(found & (sz[pos] != core_zone[:, None]), axis=1)
+
+
+def build_pip_index(polys: GeometryArray, res: int, grid: IndexSystem,
+                    chips: Optional[ChipSet] = None,
+                    dtype=jnp.float32) -> PIPIndex:
+    """Tessellate polygons and lay the chips out for device lookup."""
+    if chips is None:
+        chips = tessellate(polys, res, grid, keep_core_geom=False)
+    core = chips.is_core
+    core_cells = chips.cell_id[core]
+    core_zone = chips.geom_id[core]
+    unsafe = _unsafe_core_mask(core_cells, core_zone, grid)
+    demoted_cells = core_cells[unsafe]
+    demoted_zone = core_zone[unsafe]
+    core_cells, core_zone = core_cells[~unsafe], core_zone[~unsafe]
+    order = np.argsort(core_cells, kind="stable")
+    core_cells, core_zone = core_cells[order], core_zone[order]
+
+    b_cells = chips.cell_id[~core]
+    b_zone = chips.geom_id[~core]
+    border_idx = np.nonzero(~core)[0]
+    # demoted core cells join the border side with the cell square as chip
+    b_cells = np.concatenate([b_cells, demoted_cells])
+    b_zone = np.concatenate([b_zone, demoted_zone])
+    order = np.argsort(b_cells, kind="stable")
+    b_cells, b_zone = b_cells[order], b_zone[order]
+    if len(b_cells):
+        _, counts = np.unique(b_cells, return_counts=True)
+        max_dup = int(counts.max())
+    else:
+        max_dup = 1
+    if len(b_cells):
+        border_geoms = chips.geoms.take(border_idx)
+        if len(demoted_cells):
+            dverts, dcounts = grid.cell_boundary(demoted_cells)
+            demoted_geoms = GeometryArray.from_padded_polygons(
+                dverts, dcounts, srid=polys.srid)
+            combined = GeometryArray.concat([border_geoms, demoted_geoms])
+        else:
+            combined = border_geoms
+        chip_geoms = combined.take(order)
+    else:
+        chip_geoms = GeometryArray.empty()
+    e = build_edges(chip_geoms, dtype=dtype) if len(b_cells) else None
+    if e is None:
+        cap = 8
+        a = jnp.zeros((0, cap, 2), dtype)
+        b = jnp.zeros((0, cap, 2), dtype)
+        m = jnp.zeros((0, cap), bool)
+    else:
+        a, b, m = e.a, e.b, e.mask
+    return PIPIndex(
+        core_cells=jnp.asarray(core_cells), core_zone=jnp.asarray(
+            core_zone.astype(np.int32)),
+        border_cells=jnp.asarray(b_cells), border_zone=jnp.asarray(
+            b_zone.astype(np.int32)),
+        chip_a=a, chip_b=b, chip_mask=m, max_dup=max_dup, res=res)
+
+
+# ------------------------------------------------------------ device side
+
+def _chip_pip(points: jnp.ndarray, idx: PIPIndex,
+              slots: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Crossing-parity containment of each point in the chip at its slot.
+
+    points [N, 2], slots [N] int32 -> (inside [N] bool, min boundary
+    distance² [N]).  One gather of that chip's edges per point; the [N, E]
+    broadcast is the hot inner loop of the whole join.
+    """
+    a = idx.chip_a[slots]           # [N, E, 2]
+    b = idx.chip_b[slots]
+    mask = idx.chip_mask[slots]
+    px = points[:, None, 0]
+    py = points[:, None, 1]
+    ax, ay = a[..., 0], a[..., 1]
+    bx, by = b[..., 0], b[..., 1]
+    straddle = (ay <= py) != (by <= py)
+    t = (py - ay) / jnp.where(by == ay, jnp.ones_like(by), by - ay)
+    xi = ax + t * (bx - ax)
+    hits = straddle & (px < xi) & mask
+    inside = (jnp.sum(hits, axis=-1) & 1).astype(bool)
+    # boundary distance² for the exact-fallback band
+    ab = b - a
+    ap = points[:, None, :] - a
+    denom = jnp.sum(ab * ab, axis=-1)
+    tt = jnp.clip(jnp.sum(ap * ab, axis=-1) / jnp.where(denom == 0,
+                                                        1.0, denom), 0., 1.)
+    proj = a + tt[..., None] * ab
+    d = points[:, None, :] - proj
+    d2 = jnp.where(mask, jnp.sum(d * d, axis=-1), jnp.inf)
+    return inside, jnp.min(d2, axis=-1)
+
+
+def pip_assign(points: jnp.ndarray, cells: jnp.ndarray, idx: PIPIndex,
+               eps: float = 2e-5) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Assign each point to a polygon id (or -1).
+
+    points [N, 2] (grid CRS), cells [N] int64 (precomputed cell per point).
+    Returns (zone [N] int32, uncertain [N] bool).  ``uncertain`` marks
+    points within eps of a chip boundary — the float64 host recheck set.
+    """
+    n = points.shape[0]
+    slot, in_core = lookup(idx.core_cells, cells)
+    zone = jnp.where(in_core, idx.core_zone[slot], jnp.int32(-1))
+
+    b0, in_border = lookup(idx.border_cells, cells)
+    uncertain = jnp.zeros(n, bool)
+    for d in range(idx.max_dup):
+        s = jnp.clip(b0 + d, 0, max(idx.num_chips - 1, 0))
+        valid = in_border & (idx.border_cells[s] == cells) & \
+            (b0 + d < max(idx.num_chips, 1))
+        inside, d2 = _chip_pip(points, idx, s)
+        hit = valid & inside & (zone < 0)
+        zone = jnp.where(hit, idx.border_zone[s], zone)
+        uncertain |= valid & (d2 < eps * eps)
+    return zone, uncertain
+
+
+def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 2e-5):
+    """Close the index over a jittable ``points -> (zone, uncertain)``.
+
+    Out-of-domain points (bounded grids clip cell indices) are forced to
+    zone −1; points within eps of the domain edge are flagged uncertain so
+    the float64 host recheck is authoritative there too."""
+
+    def fn(points: jnp.ndarray):
+        cells = grid.point_to_cell_jax(points, idx.res)
+        zone, uncertain = pip_assign(points, cells, idx, eps)
+        inb = grid.point_in_bounds_jax(points)
+        near_edge = jnp.zeros_like(inb)
+        # 8-neighborhood offsets: diagonals matter for points just outside
+        # a domain corner on both axes
+        for dx in (-eps, 0., eps):
+            for dy in (-eps, 0., eps):
+                if dx == 0. and dy == 0.:
+                    continue
+                off = jnp.asarray([dx, dy], points.dtype)
+                near_edge |= grid.point_in_bounds_jax(points + off) != inb
+        return jnp.where(inb, zone, jnp.int32(-1)), uncertain | near_edge
+
+    return fn
+
+
+# ----------------------------------------------------------- sharded path
+
+def make_sharded_pip_join(idx: PIPIndex, grid: IndexSystem, mesh,
+                          eps: float = 2e-5, axis: str = "data"):
+    """The multi-chip join: points shard over ``axis``, the index
+    replicates (the reference's broadcast-join regime, SURVEY.md P2).
+
+    Returns a jitted fn points[N,2] -> (zone [N], uncertain [N]) with N
+    divisible by the mesh axis size.  Collectives only appear in
+    aggregations layered on top (see zone_histogram)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fn = make_pip_join_fn(idx, grid, eps)
+    pts_sharding = NamedSharding(mesh, P(axis, None))
+    out_sharding = (NamedSharding(mesh, P(axis)),
+                    NamedSharding(mesh, P(axis)))
+    return jax.jit(fn, in_shardings=(pts_sharding,),
+                   out_shardings=out_sharding)
+
+
+def zone_histogram(zone: jnp.ndarray, num_zones: int) -> jnp.ndarray:
+    """Per-zone match counts — the canonical aggregation after the join
+    (reference: groupBy(index_id).count()).  Under pjit this lowers to a
+    sharded segment-sum + psum over the data axis."""
+    one_hot = (zone[:, None] == jnp.arange(num_zones, dtype=zone.dtype)[None])
+    return jnp.sum(one_hot.astype(jnp.int32), axis=0)
+
+
+def pip_host_truth(points64: np.ndarray,
+                   polys: GeometryArray) -> np.ndarray:
+    """The exact float64 host oracle: first polygon containing each point
+    (crossing-number, first-match tie-break) — the single source of truth
+    that host_recheck, tests and bench all compare against."""
+    from ..core.tessellate import _pip, _poly_edges
+    truth = np.full(len(points64), -1, np.int32)
+    for gi in range(len(polys)):
+        inside = _pip(points64, _poly_edges(polys, gi))
+        truth = np.where((truth < 0) & inside, gi, truth)
+    return truth
+
+
+def host_recheck(points64: np.ndarray, zone: np.ndarray,
+                 uncertain: np.ndarray, polys: GeometryArray) -> np.ndarray:
+    """Re-run the uncertain points in float64 against the original polygons
+    (not the chips) on host — the exact tie-break authority."""
+    sel = np.nonzero(uncertain)[0]
+    if len(sel) == 0:
+        return zone
+    zone = zone.copy()
+    zone[sel] = pip_host_truth(points64[sel], polys)
+    return zone

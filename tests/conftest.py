@@ -7,16 +7,24 @@ Mirrors the reference's local-cluster distribution testing
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+# NB: this image force-registers a TPU backend from sitecustomize at
+# interpreter start, so the env-var route (JAX_PLATFORMS=cpu) is already
+# decided by the time conftest runs; jax.config.update after import is the
+# authoritative switch.  XLA_FLAGS is still read lazily at CPU-client init,
+# so setting it here works.
+import re
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+assert jax.device_count() == 8, jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

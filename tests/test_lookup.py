@@ -1,0 +1,33 @@
+"""ops.lookup: exactness of the branchless binary search."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from mosaic_tpu.ops.lookup import lookup, searchsorted
+
+
+def test_lookup_all_sizes():
+    # power-of-two sizes were a historical regression (one unroll short)
+    rng = np.random.default_rng(0)
+    for t in [1, 2, 3, 4, 7, 8, 15, 16, 17, 64, 100, 128, 1024]:
+        table = np.unique(rng.integers(0, 1 << 60, t).astype(np.int64))
+        keys = np.concatenate([table, table + 1, table - 1,
+                               np.array([-1, 1 << 62], np.int64)])
+        idx, found = lookup(jnp.asarray(table), jnp.asarray(keys))
+        idx, found = np.asarray(idx), np.asarray(found)
+        in_table = np.isin(keys, table)
+        assert np.array_equal(found, in_table), t
+        assert np.array_equal(table[idx[found]], keys[found]), t
+
+
+def test_searchsorted_matches_numpy():
+    rng = np.random.default_rng(1)
+    table = np.sort(rng.integers(0, 1000, 77).astype(np.int64))
+    keys = rng.integers(-10, 1010, 500).astype(np.int64)
+    got = np.asarray(searchsorted(jnp.asarray(table), jnp.asarray(keys)))
+    assert np.array_equal(got, np.searchsorted(table, keys, side="left"))
+
+
+def test_empty_table():
+    idx, found = lookup(jnp.zeros(0, jnp.int64), jnp.asarray([3, 4]))
+    assert not np.any(np.asarray(found))

@@ -1,0 +1,65 @@
+"""PIP-join pipeline: single-device and sharded paths vs host float64.
+
+Reference workload: Quickstart PIP join (SURVEY.md §3.2 downstream join);
+distribution testing mirrors the reference's local-cluster pattern
+(test/SparkSuite.scala local[4]) with the 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mosaic_tpu.bench.workloads import build_workload, nyc_points
+from mosaic_tpu.parallel.pip_join import (build_pip_index, host_recheck,
+                                          make_pip_join_fn,
+                                          make_sharded_pip_join,
+                                          pip_host_truth,
+                                          zone_histogram)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    polys, grid, res = build_workload(n_side=6, res_cells=64)
+    idx = build_pip_index(polys, res, grid)
+    return polys, grid, res, idx
+
+
+def test_pip_join_matches_host_f64(workload):
+    polys, grid, res, idx = workload
+    pts64 = nyc_points(20_000, seed=3)
+    fn = jax.jit(make_pip_join_fn(idx, grid))
+    zone, unc = fn(jnp.asarray(pts64, jnp.float32))
+    zone = host_recheck(pts64, np.asarray(zone), np.asarray(unc), polys)
+    truth = pip_host_truth(pts64, polys)
+    assert np.array_equal(zone, truth)
+    # a partition: everything except boundary-degenerate points matches
+    assert np.mean(truth >= 0) > 0.999
+
+
+def test_pip_join_partition_covers(workload):
+    polys, grid, res, idx = workload
+    # every cell of the bbox is core or border of some zone
+    assert len(idx.core_cells) > 0 and idx.num_chips > 0
+    assert idx.max_dup >= 2          # shared boundary cells exist
+
+
+def test_out_of_domain_points(workload):
+    polys, grid, res, idx = workload
+    fn = jax.jit(make_pip_join_fn(idx, grid))
+    pts = np.array([[-80.0, 40.7], [-74.0, 50.0], [0.0, 0.0]])
+    zone, unc = fn(jnp.asarray(pts, jnp.float32))
+    assert np.all(np.asarray(zone) == -1)
+
+
+def test_sharded_pip_join(workload):
+    polys, grid, res, idx = workload
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    fn = make_sharded_pip_join(idx, grid, mesh)
+    pts64 = nyc_points(8 * 512, seed=5)
+    zone, unc = fn(jnp.asarray(pts64, jnp.float32))
+    ref_fn = jax.jit(make_pip_join_fn(idx, grid))
+    zone1, unc1 = ref_fn(jnp.asarray(pts64, jnp.float32))
+    assert np.array_equal(np.asarray(zone), np.asarray(zone1))
+    hist = zone_histogram(zone, len(polys))
+    assert int(hist.sum()) == int(np.sum(np.asarray(zone) >= 0))
