@@ -32,13 +32,13 @@ def main():
     import jax.numpy as jnp
     from mosaic_tpu.bench.workloads import build_workload, nyc_points
     from mosaic_tpu.parallel.pip_join import (build_pip_index,
-                                              host_recheck,
+                                              host_recheck, localize,
                                               make_pip_join_fn,
                                               pip_host_truth,
                                               zone_histogram)
 
     t0 = time.time()
-    polys, grid, res = build_workload(n_side=16, res_cells=512)
+    polys, grid, res = build_workload(n_side=16, grid_name="H3")
     idx = build_pip_index(polys, res, grid)
     log(f"tessellated {len(polys)} zones -> {len(idx.core_cells)} core + "
         f"{idx.num_chips} border chips (max_dup={idx.max_dup}) "
@@ -54,7 +54,7 @@ def main():
     stepc = jax.jit(step)
     n = 1 << 22                      # 4M points per launch
     pts64 = nyc_points(n)
-    pts = jnp.asarray(pts64, jnp.float32)
+    pts = jnp.asarray(localize(idx, pts64))
     t0 = time.time()
     zone, hist, unc = jax.block_until_ready(stepc(pts))
     log(f"compile+first step: {time.time()-t0:.1f}s on "
@@ -63,8 +63,8 @@ def main():
     # steady state: distinct device-resident batches per launch so no
     # layer (XLA, runtime, tunnel) can replay a previous result
     iters = 5
-    batches = [jax.device_put(jnp.asarray(nyc_points(n, seed=100 + i),
-                                          jnp.float32))
+    batches = [jax.device_put(jnp.asarray(
+        localize(idx, nyc_points(n, seed=100 + i))))
                for i in range(iters)]
     jax.block_until_ready(batches)
     times = []
@@ -80,7 +80,7 @@ def main():
 
     # exactness: f32 device result + f64 host recheck vs full host f64 PIP
     m = 50_000
-    zs, us = jax.jit(join)(jnp.asarray(pts64[:m], jnp.float32))
+    zs, us = jax.jit(join)(jnp.asarray(localize(idx, pts64[:m])))
     zs = host_recheck(pts64[:m], np.asarray(zs), np.asarray(us), polys)
     truth = pip_host_truth(pts64[:m], polys)
     mismatch = int(np.sum(zs != truth))

@@ -63,8 +63,7 @@ def nyc_grid(res_cells: int = 512,
              ) -> Tuple[IndexSystem, int]:
     """A rectangular grid over the bbox whose finest listed resolution has
     ``res_cells`` cells per axis — cell size comparable to H3 res 9 over a
-    city (~175 m).  Swapped for H3IndexSystem once its device kernel lands.
-    """
+    city (~175 m)."""
     splits = 2
     res = int(np.round(np.log2(res_cells)))
     return CustomIndexSystem(GridConf(
@@ -72,7 +71,15 @@ def nyc_grid(res_cells: int = 512,
         (bbox[2] - bbox[0]), (bbox[3] - bbox[1]), 4326)), res
 
 
-def build_workload(n_side: int = 16, res_cells: int = 512):
-    """(polys, grid, res) for the PIP-join benchmark."""
+def build_workload(n_side: int = 16, res_cells: int = 512,
+                   grid_name: str = "CUSTOM", h3_res: int = 9):
+    """(polys, grid, res) for the PIP-join benchmark.
+
+    grid_name "H3" is the headline config (BASELINE.md config 1: taxi
+    zones at H3 res 9); "CUSTOM" keeps the rectangular grid for
+    grid-agnostic engine benchmarks."""
+    if grid_name.upper() == "H3":
+        from ..core.index.factory import get_index_system
+        return nyc_zones(n_side), get_index_system("H3"), h3_res
     grid, res = nyc_grid(res_cells)
     return nyc_zones(n_side), grid, res

@@ -57,6 +57,18 @@ class IndexSystem(abc.ABC):
         bit/float math (no tables beyond small constant gathers)."""
         raise NotImplementedError(f"{self.name} has no device kernel")
 
+    def point_to_cell_jax_margin(self, xy, res: int):
+        """(cells, margin): margin [N] is a lower-ish bound on each
+        point's distance (in CRS units) to its cell's boundary, computed
+        from the quantization residual.  The join pipeline flags points
+        with small margin for float64 host recheck — this is what makes
+        float32 device cell assignment exact-by-construction: any point
+        close enough to a cell edge for f32 rounding to matter is, by
+        definition, low-margin."""
+        import jax.numpy as jnp
+        cells = self.point_to_cell_jax(xy, res)
+        return cells, jnp.full(xy.shape[:-1], jnp.inf, xy.dtype)
+
     def point_in_bounds_jax(self, xy):
         """jax-traceable [N, 2] -> [N] bool: point lies inside the grid's
         valid domain.  Global grids (H3) cover the sphere and return all

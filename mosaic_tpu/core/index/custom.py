@@ -133,6 +133,17 @@ class CustomIndexSystem(IndexSystem):
         iy = jnp.clip(iy, 0, self.cells_per_axis_y(res) - 1)
         return (jnp.int64(res) << _RES_SHIFT) | (iy << _Y_SHIFT) | ix
 
+    def point_to_cell_jax_margin(self, xy, res: int):
+        import jax.numpy as jnp
+        cells = self.point_to_cell_jax(xy, res)
+        c = self.conf
+        sx, sy = self.cell_size(res)
+        fx = jnp.mod((xy[..., 0] - c.bound_x_min) / sx, 1.0)
+        fy = jnp.mod((xy[..., 1] - c.bound_y_min) / sy, 1.0)
+        mx = jnp.minimum(fx, 1.0 - fx) * sx
+        my = jnp.minimum(fy, 1.0 - fy) * sy
+        return cells, jnp.minimum(mx, my)
+
     def cell_center(self, cells: np.ndarray) -> np.ndarray:
         res, ix, iy = self._unpack(cells)
         c = self.conf

@@ -1,0 +1,179 @@
+"""Device-side H3 cell assignment (jax, float32 projection + exact int32
+lattice math).
+
+The reference assigns cells row-at-a-time through JNI
+(H3IndexSystem.pointToIndex:168 -> h3.geoToH3); here the whole pipeline —
+nearest icosahedron face, gnomonic projection, hex cube-rounding,
+aperture-7 aggregation, base-cell lookup, digit rotation — is branch-free
+tensor math that XLA fuses into one kernel.  Only the projection runs in
+f32, good to ~1e-3 cell widths through res 12 (sub-meter at res 9; the
+PIP join's eps band + float64 host recheck covers the boundary sliver).
+Above res 12 use the float64 host path.
+
+Axial-coordinate forms (a, b) = (i - k, j - k) of the aperture-7 steps,
+derived from the ijk matrices in hexmath.py:
+
+    plain:  up  a'=round((3a-b)/7), b'=round((a+2b)/7)
+            down A=2a+b,  B=-a+3b
+    rot:    up  a'=round((2a+b)/7), b'=round((3b-a)/7)
+            down A=3a-b,  B=a+2b
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .constants import (FACE_AXES_AZ_I, FACE_CENTER_GEO, M_AP7_ROT_RADS,
+                        M_SIN60, M_SQRT7, RES0_U_GNOMONIC,
+                        face_center_xyz)
+from .index import MODE_CELL, _BASE_SHIFT, _MODE_SHIFT, _RES_SHIFT, \
+    _digit_shift
+from .tables import _down_rot, tables
+
+# axial diff (da+1)*3 + (db+1) -> digit (7 = impossible)
+_DIGIT_OF_DIFF = np.array([1, 3, 7, 5, 0, 2, 7, 4, 6], dtype=np.int32)
+
+_CONSTS = None
+
+
+def _consts():
+    """Numpy-held constants, wrapped to jnp per call so jit traces embed
+    them as constants instead of leaking cached tracers."""
+    global _CONSTS
+    if _CONSTS is None:
+        t = tables()
+        _CONSTS = {
+            "face_xyz": face_center_xyz().astype(np.float32),
+            "face_geo": FACE_CENTER_GEO.astype(np.float32),
+            "face_az": FACE_AXES_AZ_I.astype(np.float32),
+            "fijk_base": t.fijk_base.reshape(-1).astype(np.int32),
+            "fijk_rot": np.maximum(t.fijk_rot, 0).reshape(-1).astype(
+                np.int32),
+            "fijk_extra": t.fijk_pent_extra.reshape(-1).astype(np.int32),
+            "rot_digit": t.rot_digit.reshape(-1).astype(np.int32),
+            "is_pent": t.is_pentagon.astype(np.int32),
+            "pent_seam": t.pent_seam.astype(np.int32),
+            "digit_of_diff": _DIGIT_OF_DIFF,
+        }
+    return {k: jnp.asarray(v) for k, v in _CONSTS.items()}
+
+
+def _round_div7(p):
+    """Nearest-integer p/7 for int32 p (ties impossible for integer p)."""
+    return jnp.floor_divide(2 * p + 7, 14)
+
+
+def latlng_to_cell_jax(lat, lng, res: int):
+    """lat, lng (radians) -> int64 cell ids; shapes broadcast."""
+    return latlng_to_cell_jax_margin(lat, lng, res)[0]
+
+
+def latlng_to_cell_jax_margin(lat, lng, res: int):
+    """(cells, margin): margin is the approximate angular distance
+    (radians) from each point to its hex cell's boundary, straight from
+    the quantization residual — the device-side uncertainty signal."""
+    c = _consts()
+    lat = lat.astype(jnp.float32)
+    lng = lng.astype(jnp.float32)
+    cl = jnp.cos(lat)
+    xyz = jnp.stack([cl * jnp.cos(lng), cl * jnp.sin(lng), jnp.sin(lat)],
+                    axis=-1)
+    dots = xyz @ c["face_xyz"].T
+    face = jnp.argmax(dots, axis=-1).astype(jnp.int32)
+    cosd = jnp.clip(jnp.max(dots, axis=-1), -1.0, 1.0)
+    r = jnp.arccos(cosd)
+
+    flat = c["face_geo"][face, 0]
+    flng = c["face_geo"][face, 1]
+    dl = lng - flng
+    az_y = jnp.cos(lat) * jnp.sin(dl)
+    az_x = jnp.cos(flat) * jnp.sin(lat) - \
+        jnp.sin(flat) * jnp.cos(lat) * jnp.cos(dl)
+    az = jnp.arctan2(az_y, az_x)
+    two_pi = np.float32(2 * np.pi)
+    theta = jnp.mod(c["face_az"][face] - jnp.mod(az, two_pi), two_pi)
+    if res % 2 == 1:
+        theta = jnp.mod(theta - np.float32(M_AP7_ROT_RADS), two_pi)
+    rr = jnp.tan(r) * np.float32(M_SQRT7 ** res / RES0_U_GNOMONIC)
+    x = rr * jnp.cos(theta)
+    y = rr * jnp.sin(theta)
+
+    # cube rounding to the hex lattice, in the 60°-basis axial frame
+    # (q, r) = (a - b, b) where cube rounding is valid
+    rf = y / np.float32(M_SIN60)
+    qf = x - 0.5 * rf
+    sf = -qf - rf
+    rq, rr, rs = jnp.round(qf), jnp.round(rf), jnp.round(sf)
+    dq, dr, ds = jnp.abs(rq - qf), jnp.abs(rr - rf), jnp.abs(rs - sf)
+    fix_q = (dq > dr) & (dq > ds)
+    fix_r = (~fix_q) & (dr > ds)
+    rq = jnp.where(fix_q, -rr - rs, rq)
+    rr = jnp.where(fix_r, -rq - rs, rr)
+    ai = (rq + rr).astype(jnp.int32)
+    bi = rr.astype(jnp.int32)
+
+    # distance to the hex Voronoi boundary: residual vector in the planar
+    # frame, projected onto the 6 neighbor directions (at k*60°)
+    cax = (rq + rr) - 0.5 * rr          # center x = a - b/2
+    cay = rr * np.float32(M_SIN60)
+    vx = x - cax
+    vy = y - cay
+    proj = jnp.maximum(jnp.abs(vx),
+                       jnp.maximum(jnp.abs(0.5 * vx +
+                                           np.float32(M_SIN60) * vy),
+                                   jnp.abs(-0.5 * vx +
+                                           np.float32(M_SIN60) * vy)))
+    margin_lattice = jnp.maximum(0.5 - proj, 0.0)
+    # lattice unit -> radians (gnomonic scale; distortion only enlarges)
+    margin = margin_lattice * np.float32(
+        RES0_U_GNOMONIC / M_SQRT7 ** res)
+
+    # aperture-7 aggregation, collecting one digit per resolution step
+    digits = [None] * (res + 1)
+    for rv in range(res, 0, -1):
+        if _down_rot(rv):
+            ua = _round_div7(2 * ai + bi)
+            ub = _round_div7(3 * bi - ai)
+            ca = 3 * ua - ub
+            cb = ua + 2 * ub
+        else:
+            ua = _round_div7(3 * ai - bi)
+            ub = _round_div7(ai + 2 * bi)
+            ca = 2 * ua + ub
+            cb = -ua + 3 * ub
+        da = ai - ca
+        db = bi - cb
+        digits[rv] = c["digit_of_diff"][(da + 1) * 3 + (db + 1)]
+        ai, bi = ua, ub
+
+    # res-0 normalized ijk and base-cell entry
+    mn = jnp.minimum(jnp.minimum(ai, bi), 0)
+    i0 = ai - mn
+    j0 = bi - mn
+    k0 = -mn
+    entry = ((face * 3 + i0) * 3 + j0) * 3 + k0
+    base = c["fijk_base"][entry]
+    r0 = c["fijk_rot"][entry]
+
+    # rotate digits to canonical orientation
+    lead = jnp.zeros_like(base)
+    for rv in range(1, res + 1):
+        digits[rv] = c["rot_digit"][r0 * 7 + digits[rv]]
+        lead = jnp.where((lead == 0) & (digits[rv] != 0), digits[rv],
+                         lead)
+    # pentagon seam re-expression
+    seam_hit = (c["is_pent"][base] == 1) & (lead == c["pent_seam"][base])\
+        & (lead != 0)
+    extra = jnp.where(seam_hit, c["fijk_extra"][entry], 0)
+    h = (jnp.int64(MODE_CELL) << _MODE_SHIFT) | \
+        (jnp.int64(res) << _RES_SHIFT) | \
+        (base.astype(jnp.int64) << _BASE_SHIFT)
+    fill = np.int64(0)
+    for rv in range(res + 1, 16):
+        fill |= np.int64(7) << _digit_shift(rv)
+    h = h | jnp.int64(fill)
+    for rv in range(1, res + 1):
+        d = c["rot_digit"][extra * 7 + digits[rv]]
+        h = h | (d.astype(jnp.int64) << _digit_shift(rv))
+    return h, margin

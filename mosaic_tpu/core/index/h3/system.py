@@ -1,0 +1,215 @@
+"""H3IndexSystem — the hexagonal grid behind the IndexSystem contract.
+
+Reference counterpart: core/index/H3IndexSystem.scala:24 (singleton,
+LongType ids, all cell math delegated to Uber's native H3 core through
+JNI).  Here the grid is the from-scratch aperture-7 icosahedral DGGS in
+h3/: same cell-id bit layout, same topology (122 base cells, 12
+pentagons, resolutions 0-15), pure vectorized numpy + a JAX device kernel
+for point_to_cell.
+
+Grid CRS is EPSG:4326; (x, y) = (lon, lat) degrees, like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..base import IndexSystem
+from . import index as ix
+from .constants import MAX_H3_RES
+from .hexmath import geo_to_xyz
+
+EARTH_RADIUS_KM = 6371.0088
+
+
+def _deg_to_latlng(xy: np.ndarray) -> np.ndarray:
+    xy = np.atleast_2d(np.asarray(xy, np.float64))
+    return np.stack([np.radians(xy[..., 1]), np.radians(xy[..., 0])],
+                    axis=-1)
+
+
+def _latlng_to_deg(latlng: np.ndarray) -> np.ndarray:
+    return np.stack([np.degrees(latlng[..., 1]),
+                     np.degrees(latlng[..., 0])], axis=-1)
+
+
+class H3IndexSystem(IndexSystem):
+    name = "H3"
+    crs_id = 4326
+    string_ids = False
+
+    def __init__(self):
+        self._inradius_deg: Dict[int, float] = {}
+        self._circum_deg: Dict[int, float] = {}
+
+    def resolutions(self) -> range:
+        return range(0, MAX_H3_RES + 1)
+
+    def resolution_of(self, cells: np.ndarray) -> np.ndarray:
+        return ix.get_resolution(np.atleast_1d(np.asarray(cells, np.int64)))
+
+    def point_to_cell(self, xy: np.ndarray, res: int) -> np.ndarray:
+        self._check_res(res)
+        return ix.latlng_to_cell(_deg_to_latlng(xy), res)
+
+    def _check_res(self, res: int) -> None:
+        if res not in self.resolutions():
+            raise ValueError(f"resolution {res} outside supported range "
+                             f"{self.resolutions()} for H3")
+
+    def cell_center(self, cells: np.ndarray) -> np.ndarray:
+        return _latlng_to_deg(ix.cell_to_latlng(cells))
+
+    def cell_boundary(self, cells: np.ndarray) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+        verts, counts = ix.cell_boundary(cells)
+        out = _latlng_to_deg(verts)
+        # unwrap cells straddling the antimeridian: keep vertex longitudes
+        # within 180° of the center longitude (reference splits these
+        # geometries instead, H3IndexSystem.scala:261-265)
+        center = self.cell_center(cells)
+        dlon = out[..., 0] - center[:, None, 0]
+        out[..., 0] -= 360.0 * np.round(dlon / 360.0)
+        # pad rows beyond count with the last valid vertex
+        k = np.arange(out.shape[1])[None, :]
+        last = np.take_along_axis(out, (counts[:, None, None] - 1)
+                                  .repeat(2, axis=2), axis=1)
+        mask = (k < counts[:, None])[:, :, None]
+        out = np.where(mask, out, last)
+        return out, counts.astype(np.int32)
+
+    def k_ring(self, cells: np.ndarray, k: int) -> np.ndarray:
+        return ix.k_ring(np.atleast_1d(np.asarray(cells, np.int64)), k)
+
+    def k_loop(self, cells: np.ndarray, k: int) -> np.ndarray:
+        return ix.k_loop(np.atleast_1d(np.asarray(cells, np.int64)), k)
+
+    # -------------------------------------------------------- candidates
+    def _cell_metrics_deg(self, res: int) -> Tuple[float, float]:
+        """(min inradius, max circumradius) in degrees at a resolution —
+        global worst case over sampled cells, with safety margin."""
+        if res not in self._inradius_deg:
+            rng = np.random.default_rng(17)
+            n = 400
+            pts = np.stack([np.degrees(
+                np.arcsin(rng.uniform(-1, 1, n))),
+                rng.uniform(-180, 180, n)], axis=-1)[:, ::-1]
+            cells = np.unique(self.point_to_cell(pts, res))
+            verts, counts = self.cell_boundary(cells)
+            center = self.cell_center(cells)
+            # angular distances center->vertices (degrees, chord approx)
+            cv = geo_to_xyz(_deg_to_latlng(center))
+            vv = geo_to_xyz(_deg_to_latlng(verts.reshape(-1, 2))).reshape(
+                len(cells), -1, 3)
+            chord = np.linalg.norm(vv - cv[:, None], axis=-1)
+            ang = np.degrees(2 * np.arcsin(np.clip(chord / 2, 0, 1)))
+            k = np.arange(ang.shape[1])[None, :]
+            valid = k < counts[:, None]
+            circum = np.max(np.where(valid, ang, 0))
+            # inradius via edge midpoints
+            nxt = np.where(k + 1 >= counts[:, None], 0, k + 1)
+            vmid = 0.5 * (vv + np.take_along_axis(
+                vv, nxt[:, :, None], axis=1))
+            vmid /= np.linalg.norm(vmid, axis=-1, keepdims=True)
+            chord_m = np.linalg.norm(vmid - cv[:, None], axis=-1)
+            ang_m = np.degrees(2 * np.arcsin(np.clip(chord_m / 2, 0, 1)))
+            inr = np.min(np.where(valid, ang_m, np.inf))
+            self._inradius_deg[res] = float(inr) * 0.9
+            self._circum_deg[res] = float(circum) * 1.1
+        return self._inradius_deg[res], self._circum_deg[res]
+
+    def candidate_cells(self, bbox: np.ndarray, res: int,
+                        max_cells: int = 4_000_000) -> np.ndarray:
+        """Cells possibly intersecting a lon/lat bbox, by lattice-dense
+        point sampling + dedupe (every cell contains a disk of its
+        inradius, so a sample grid at that spacing hits every cell)."""
+        self._check_res(res)
+        inr, circ = self._cell_metrics_deg(res)
+        x0, y0, x1, y1 = (float(bbox[0]) - circ, float(bbox[1]) - circ,
+                          float(bbox[2]) + circ, float(bbox[3]) + circ)
+        y0, y1 = max(y0, -90.0), min(y1, 90.0)
+        coslat = max(np.cos(np.radians(max(abs(y0), abs(y1)))), 1e-3)
+        sx = inr / coslat / np.sqrt(2.0)
+        sy = inr / np.sqrt(2.0)
+        nx = int(np.ceil((x1 - x0) / sx)) + 1
+        ny = int(np.ceil((y1 - y0) / sy)) + 1
+        if nx * ny > 4 * max_cells:
+            raise ValueError(f"bbox needs {nx * ny} samples at res {res}")
+        gx, gy = np.meshgrid(x0 + np.arange(nx) * sx,
+                             y0 + np.arange(ny) * sy, indexing="ij")
+        pts = np.stack([gx.ravel(), gy.ravel()], axis=-1)
+        cells = np.unique(self.point_to_cell(pts, res))
+        if len(cells) > max_cells:
+            raise ValueError(
+                f"bbox covers {len(cells)} cells at res {res}")
+        return cells
+
+    # ------------------------------------------------------------- area
+    def cell_area(self, cells: np.ndarray) -> np.ndarray:
+        """Spherical-excess area in km² (reference: IndexSystem.area
+        computes spherical triangle areas via haversine,
+        core/index/IndexSystem.scala:248-291)."""
+        cells = np.atleast_1d(np.asarray(cells, np.int64))
+        verts, counts = ix.cell_boundary(cells)
+        xyz = geo_to_xyz(verts)                        # [N, 6, 3]
+        n, m = xyz.shape[:2]
+        total = np.zeros(n)
+        k = np.arange(m)[None, :]
+        for i in range(m):
+            prv = np.where(i - 1 < 0, counts - 1, i - 1)
+            nxt = np.where(i + 1 >= counts, 0, i + 1)
+            a = xyz[np.arange(n), prv]
+            b = xyz[:, i]
+            c = xyz[np.arange(n), nxt]
+            t1 = np.cross(b, a)
+            t2 = np.cross(b, c)
+            t1 /= np.maximum(np.linalg.norm(t1, axis=-1, keepdims=True),
+                             1e-300)
+            t2 /= np.maximum(np.linalg.norm(t2, axis=-1, keepdims=True),
+                             1e-300)
+            ang = np.arccos(np.clip(np.sum(t1 * t2, axis=-1), -1, 1))
+            total += np.where(i < counts, ang, 0.0)
+        excess = np.abs(total - (counts - 2) * np.pi)
+        return excess * EARTH_RADIUS_KM ** 2
+
+    def grid_distance(self, cells_a: np.ndarray,
+                      cells_b: np.ndarray) -> np.ndarray:
+        """Exact grid-step distance via expanding rings (reference:
+        GridDistance expression -> h3.h3Distance).  Intended for nearby
+        pairs; raises beyond ``cap`` rings like h3Distance errors out for
+        distant cells."""
+        a = np.atleast_1d(np.asarray(cells_a, np.int64))
+        b = np.atleast_1d(np.asarray(cells_b, np.int64))
+        out = np.full(len(a), -1, np.int64)
+        out[a == b] = 0
+        cap = 64
+        todo = np.nonzero(out < 0)[0]
+        k = 0
+        frontier = a.copy()
+        while len(todo) and k < cap:
+            k += 1
+            ring = ix.k_ring(a[todo], k)
+            hit = np.any(ring == b[todo, None], axis=1)
+            out[todo[hit]] = k
+            todo = todo[~hit]
+        if len(todo):
+            raise ValueError(f"grid_distance exceeds cap {cap}")
+        return out
+
+    def point_in_bounds_jax(self, xy):
+        import jax.numpy as jnp
+        return jnp.ones(xy.shape[:-1], bool)
+
+    def point_to_cell_jax(self, xy, res: int):
+        return self.point_to_cell_jax_margin(xy, res)[0]
+
+    def point_to_cell_jax_margin(self, xy, res: int):
+        from .jaxkernel import latlng_to_cell_jax_margin
+        import jax.numpy as jnp
+        self._check_res(res)
+        lat = jnp.radians(xy[..., 1])
+        lng = jnp.radians(xy[..., 0])
+        cells, margin = latlng_to_cell_jax_margin(lat, lng, res)
+        return cells, jnp.degrees(margin)

@@ -335,11 +335,7 @@ class MosaicContext:
     def grid_boundary(self, cells) -> Geoms:
         verts, counts = self.index_system.cell_boundary(
             np.asarray(cells, np.int64))
-        b = GeometryBuilder()
-        for i in range(len(counts)):
-            ring = verts[i, :counts[i]]
-            b.add_polygon(np.vstack([ring, ring[:1]]))
-        return b.finish()
+        return GeometryArray.from_padded_polygons(verts, counts)
 
     def grid_boundaryaswkb(self, cells) -> List[bytes]:
         return write_wkb(self.grid_boundary(cells))

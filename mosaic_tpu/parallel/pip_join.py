@@ -66,12 +66,18 @@ class PIPIndex:
     chip_a: jnp.ndarray
     chip_b: jnp.ndarray
     chip_mask: jnp.ndarray
+    #: local-frame origin (lon, lat float64): chip coords are stored
+    #: origin-shifted so float32 edge-crossing arithmetic operates on
+    #: small magnitudes (absolute lon ~74° costs ~4e-5° of cancellation
+    #: error — far above the eps band; shifted it is ~1e-7°)
+    origin: jnp.ndarray
     max_dup: int
     res: int
 
     def tree_flatten(self):
         return ((self.core_cells, self.core_zone, self.border_cells,
-                 self.border_zone, self.chip_a, self.chip_b, self.chip_mask),
+                 self.border_zone, self.chip_a, self.chip_b,
+                 self.chip_mask, self.origin),
                 (self.max_dup, self.res))
 
     @classmethod
@@ -83,50 +89,28 @@ class PIPIndex:
         return self.border_cells.shape[0]
 
 
-def _unsafe_core_mask(core_cells: np.ndarray, core_zone: np.ndarray,
-                      grid: IndexSystem) -> np.ndarray:
-    """Core cells that abut a core cell of a DIFFERENT zone.
-
-    The device assigns cells in float32; a point within ~1 ulp of a cell
-    edge can land in the neighboring cell.  That is harmless when the
-    neighbor resolves through chip tests (the eps band flags it) or is core
-    of the same zone — the one silent-corruption case is two different
-    zones' core cells sharing an edge (zone boundary exactly on the grid).
-    Those cells are demoted to full-cell border chips at build time so the
-    hazard funnels through the chip eps machinery; the fast core path then
-    never answers wrongly."""
-    if len(core_cells) == 0:
-        return np.zeros(0, bool)
-    order = np.argsort(core_cells, kind="stable")
-    sc, sz = core_cells[order], core_zone[order]
-    ring = grid.k_ring(core_cells, 1)                       # [C, m]
-    pos = np.clip(np.searchsorted(sc, ring), 0, len(sc) - 1)
-    found = (sc[pos] == ring) & (ring >= 0)
-    return np.any(found & (sz[pos] != core_zone[:, None]), axis=1)
-
-
 def build_pip_index(polys: GeometryArray, res: int, grid: IndexSystem,
                     chips: Optional[ChipSet] = None,
                     dtype=jnp.float32) -> PIPIndex:
-    """Tessellate polygons and lay the chips out for device lookup."""
+    """Tessellate polygons and lay the chips out for device lookup.
+
+    Float32 cell-assignment hazards need no special index structure: the
+    device quantizer reports a boundary margin, and low-margin points are
+    flagged for the float64 host recheck (see make_pip_join_fn)."""
     if chips is None:
         chips = tessellate(polys, res, grid, keep_core_geom=False)
+    bb = polys.bboxes()
+    origin = np.round(np.array(
+        [np.nanmean(bb[:, [0, 2]]), np.nanmean(bb[:, [1, 3]])]), 1)
     core = chips.is_core
     core_cells = chips.cell_id[core]
     core_zone = chips.geom_id[core]
-    unsafe = _unsafe_core_mask(core_cells, core_zone, grid)
-    demoted_cells = core_cells[unsafe]
-    demoted_zone = core_zone[unsafe]
-    core_cells, core_zone = core_cells[~unsafe], core_zone[~unsafe]
     order = np.argsort(core_cells, kind="stable")
     core_cells, core_zone = core_cells[order], core_zone[order]
 
     b_cells = chips.cell_id[~core]
     b_zone = chips.geom_id[~core]
     border_idx = np.nonzero(~core)[0]
-    # demoted core cells join the border side with the cell square as chip
-    b_cells = np.concatenate([b_cells, demoted_cells])
-    b_zone = np.concatenate([b_zone, demoted_zone])
     order = np.argsort(b_cells, kind="stable")
     b_cells, b_zone = b_cells[order], b_zone[order]
     if len(b_cells):
@@ -135,15 +119,8 @@ def build_pip_index(polys: GeometryArray, res: int, grid: IndexSystem,
     else:
         max_dup = 1
     if len(b_cells):
-        border_geoms = chips.geoms.take(border_idx)
-        if len(demoted_cells):
-            dverts, dcounts = grid.cell_boundary(demoted_cells)
-            demoted_geoms = GeometryArray.from_padded_polygons(
-                dverts, dcounts, srid=polys.srid)
-            combined = GeometryArray.concat([border_geoms, demoted_geoms])
-        else:
-            combined = border_geoms
-        chip_geoms = combined.take(order)
+        chip_geoms = chips.geoms.take(border_idx[order])
+        chip_geoms.coords = chip_geoms.coords - origin[None, :2]
     else:
         chip_geoms = GeometryArray.empty()
     e = build_edges(chip_geoms, dtype=dtype) if len(b_cells) else None
@@ -159,7 +136,9 @@ def build_pip_index(polys: GeometryArray, res: int, grid: IndexSystem,
             core_zone.astype(np.int32)),
         border_cells=jnp.asarray(b_cells), border_zone=jnp.asarray(
             b_zone.astype(np.int32)),
-        chip_a=a, chip_b=b, chip_mask=m, max_dup=max_dup, res=res)
+        chip_a=a, chip_b=b, chip_mask=m,
+        origin=jnp.asarray(origin, jnp.float64),
+        max_dup=max_dup, res=res)
 
 
 # ------------------------------------------------------------ device side
@@ -197,7 +176,7 @@ def _chip_pip(points: jnp.ndarray, idx: PIPIndex,
 
 
 def pip_assign(points: jnp.ndarray, cells: jnp.ndarray, idx: PIPIndex,
-               eps: float = 2e-5) -> Tuple[jnp.ndarray, jnp.ndarray]:
+               eps: float = 1e-5) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Assign each point to a polygon id (or -1).
 
     points [N, 2] (grid CRS), cells [N] int64 (precomputed cell per point).
@@ -221,17 +200,34 @@ def pip_assign(points: jnp.ndarray, cells: jnp.ndarray, idx: PIPIndex,
     return zone, uncertain
 
 
-def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 2e-5):
-    """Close the index over a jittable ``points -> (zone, uncertain)``.
+def localize(idx: PIPIndex, points64: np.ndarray) -> np.ndarray:
+    """Absolute float64 points -> local-frame float32 device input.
 
-    Out-of-domain points (bounded grids clip cell indices) are forced to
-    zone −1; points within eps of the domain edge are flagged uncertain so
-    the float64 host recheck is authoritative there too."""
+    The origin shift happens in float64 BEFORE the float32 cast, so the
+    device sees full point precision in the frame the chips live in."""
+    return np.asarray(points64 - np.asarray(idx.origin)[None],
+                      np.float32)
+
+
+def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 1e-5,
+                     margin_eps: float = 3e-5):
+    """Close the index over a jittable ``local_points -> (zone,
+    uncertain)``; inputs come from ``localize`` (local-frame float32).
+
+    Exactness contract: every float32 hazard raises ``uncertain``, and
+    host_recheck resolves those in float64 — (a) points within ``eps`` of
+    a chip boundary (crossing-parity rounding), (b) points whose
+    cell-boundary margin is below ``margin_eps`` (cell assignment could
+    differ from the float64 path: local→absolute rounding ~4e-6° plus
+    f32 projection error), (c) points near the grid's domain edge.
+    Out-of-domain points are forced to zone −1."""
 
     def fn(points: jnp.ndarray):
-        cells = grid.point_to_cell_jax(points, idx.res)
+        absolute = points + idx.origin.astype(points.dtype)
+        cells, margin = grid.point_to_cell_jax_margin(absolute, idx.res)
         zone, uncertain = pip_assign(points, cells, idx, eps)
-        inb = grid.point_in_bounds_jax(points)
+        uncertain |= margin < margin_eps
+        inb = grid.point_in_bounds_jax(absolute)
         near_edge = jnp.zeros_like(inb)
         # 8-neighborhood offsets: diagonals matter for points just outside
         # a domain corner on both axes
@@ -240,7 +236,8 @@ def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 2e-5):
                 if dx == 0. and dy == 0.:
                     continue
                 off = jnp.asarray([dx, dy], points.dtype)
-                near_edge |= grid.point_in_bounds_jax(points + off) != inb
+                near_edge |= grid.point_in_bounds_jax(
+                    absolute + off) != inb
         return jnp.where(inb, zone, jnp.int32(-1)), uncertain | near_edge
 
     return fn
@@ -249,7 +246,8 @@ def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 2e-5):
 # ----------------------------------------------------------- sharded path
 
 def make_sharded_pip_join(idx: PIPIndex, grid: IndexSystem, mesh,
-                          eps: float = 2e-5, axis: str = "data"):
+                          eps: float = 1e-5, margin_eps: float = 3e-5,
+                          axis: str = "data"):
     """The multi-chip join: points shard over ``axis``, the index
     replicates (the reference's broadcast-join regime, SURVEY.md P2).
 
@@ -258,7 +256,7 @@ def make_sharded_pip_join(idx: PIPIndex, grid: IndexSystem, mesh,
     aggregations layered on top (see zone_histogram)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    fn = make_pip_join_fn(idx, grid, eps)
+    fn = make_pip_join_fn(idx, grid, eps, margin_eps)
     pts_sharding = NamedSharding(mesh, P(axis, None))
     out_sharding = (NamedSharding(mesh, P(axis)),
                     NamedSharding(mesh, P(axis)))
@@ -268,10 +266,12 @@ def make_sharded_pip_join(idx: PIPIndex, grid: IndexSystem, mesh,
 
 def zone_histogram(zone: jnp.ndarray, num_zones: int) -> jnp.ndarray:
     """Per-zone match counts — the canonical aggregation after the join
-    (reference: groupBy(index_id).count()).  Under pjit this lowers to a
-    sharded segment-sum + psum over the data axis."""
-    one_hot = (zone[:, None] == jnp.arange(num_zones, dtype=zone.dtype)[None])
-    return jnp.sum(one_hot.astype(jnp.int32), axis=0)
+    (reference: groupBy(index_id).count()).  A scatter-add segment sum
+    (O(N), not an O(N·Z) one-hot); unmatched (-1) rows are dropped.
+    Under pjit this lowers to a sharded segment-sum + psum over the data
+    axis."""
+    return jnp.zeros(num_zones, jnp.int32).at[zone].add(
+        1, mode="drop", indices_are_sorted=False)
 
 
 def pip_host_truth(points64: np.ndarray,

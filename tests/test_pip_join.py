@@ -12,7 +12,7 @@ import pytest
 
 from mosaic_tpu.bench.workloads import build_workload, nyc_points
 from mosaic_tpu.parallel.pip_join import (build_pip_index, host_recheck,
-                                          make_pip_join_fn,
+                                          localize, make_pip_join_fn,
                                           make_sharded_pip_join,
                                           pip_host_truth,
                                           zone_histogram)
@@ -29,7 +29,7 @@ def test_pip_join_matches_host_f64(workload):
     polys, grid, res, idx = workload
     pts64 = nyc_points(20_000, seed=3)
     fn = jax.jit(make_pip_join_fn(idx, grid))
-    zone, unc = fn(jnp.asarray(pts64, jnp.float32))
+    zone, unc = fn(jnp.asarray(localize(idx, pts64)))
     zone = host_recheck(pts64, np.asarray(zone), np.asarray(unc), polys)
     truth = pip_host_truth(pts64, polys)
     assert np.array_equal(zone, truth)
@@ -48,7 +48,7 @@ def test_out_of_domain_points(workload):
     polys, grid, res, idx = workload
     fn = jax.jit(make_pip_join_fn(idx, grid))
     pts = np.array([[-80.0, 40.7], [-74.0, 50.0], [0.0, 0.0]])
-    zone, unc = fn(jnp.asarray(pts, jnp.float32))
+    zone, unc = fn(jnp.asarray(localize(idx, pts)))
     assert np.all(np.asarray(zone) == -1)
 
 
@@ -57,9 +57,9 @@ def test_sharded_pip_join(workload):
     mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
     fn = make_sharded_pip_join(idx, grid, mesh)
     pts64 = nyc_points(8 * 512, seed=5)
-    zone, unc = fn(jnp.asarray(pts64, jnp.float32))
+    zone, unc = fn(jnp.asarray(localize(idx, pts64)))
     ref_fn = jax.jit(make_pip_join_fn(idx, grid))
-    zone1, unc1 = ref_fn(jnp.asarray(pts64, jnp.float32))
+    zone1, unc1 = ref_fn(jnp.asarray(localize(idx, pts64)))
     assert np.array_equal(np.asarray(zone), np.asarray(zone1))
     hist = zone_histogram(zone, len(polys))
     assert int(hist.sum()) == int(np.sum(np.asarray(zone) >= 0))
