@@ -269,7 +269,12 @@ def zone_histogram(zone: jnp.ndarray, num_zones: int) -> jnp.ndarray:
     (reference: groupBy(index_id).count()).  A scatter-add segment sum
     (O(N), not an O(N·Z) one-hot); unmatched (-1) rows are dropped.
     Under pjit this lowers to a sharded segment-sum + psum over the data
-    axis."""
+    axis.
+
+    ``.at[].add(mode="drop")`` normalizes negative indices NumPy-style
+    *before* dropping, so -1 would wrap to the last zone; remap invalid
+    rows to ``num_zones`` (genuinely out of bounds) so drop applies."""
+    zone = jnp.where(zone < 0, jnp.int32(num_zones), zone)
     return jnp.zeros(num_zones, jnp.int32).at[zone].add(
         1, mode="drop", indices_are_sorted=False)
 
