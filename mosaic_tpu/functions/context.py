@@ -289,6 +289,106 @@ class MosaicContext:
         c[:, 1] = x * np.sin(theta) + y * np.cos(theta)
         return _dc.replace(g, coords=c)
 
+    # ------------------------------------------------------------------
+    # overlay ops (general polygon boolean algebra)
+    # (reference: MosaicGeometry.intersection/union/difference,
+    #  core/geometry/MosaicGeometry.scala:125-160, via JTS overlay)
+    # ------------------------------------------------------------------
+    def st_intersection(self, a: Geoms, b: Geoms) -> Geoms:
+        """Row-wise polygon intersection (reference: ST_Intersection)."""
+        from ..core.geometry.clip import boolean_op
+        return boolean_op(a, b, "intersection")
+
+    def st_union(self, a: Geoms, b: Geoms) -> Geoms:
+        """Row-wise polygon union (reference: ST_Union)."""
+        from ..core.geometry.clip import boolean_op
+        return boolean_op(a, b, "union")
+
+    def st_difference(self, a: Geoms, b: Geoms) -> Geoms:
+        """Row-wise a minus b (reference: ST_Difference)."""
+        from ..core.geometry.clip import boolean_op
+        return boolean_op(a, b, "difference")
+
+    def st_symdifference(self, a: Geoms, b: Geoms) -> Geoms:
+        """Row-wise symmetric difference (reference: JTS symDifference)."""
+        from ..core.geometry.clip import boolean_op
+        return boolean_op(a, b, "symdifference")
+
+    def st_unaryunion(self, g: Geoms) -> Geoms:
+        """Union the parts of each (multi)polygon row, resolving part
+        overlaps (reference: ST_UnaryUnion)."""
+        from ..core.geometry.clip import unary_union_rings, rings_to_array
+        b = GeometryBuilder(srid=g.srid)
+        for gi in range(len(g)):
+            _, parts = g.geom_slices(gi)
+            regions = [[np.asarray(r, np.float64)[:, :2] for r in rings]
+                       for rings in parts]
+            rings_to_array(unary_union_rings(regions), builder=b)
+        return b.finish()
+
+    def st_intersection_agg(self, left: ChipSet, right: ChipSet) -> Geoms:
+        """Reconstruct the intersection geometry of two tessellated
+        geometries from cell-matched chip pairs.
+
+        ``left`` and ``right`` are row-aligned chips on the SAME cell ids
+        (the post-join layout).  Core∧core ⇒ whole cell, core∧border ⇒
+        border chip, border∧border ⇒ chip∩chip; all increments unioned
+        (reference: ST_IntersectionAgg.scala:41-58 update/merge)."""
+        from ..core.geometry.clip import (geometry_rings, rings_boolean,
+                                          rings_to_array, unary_union_rings)
+        if len(left.cell_id) != len(right.cell_id):
+            raise ValueError("left/right chip batches must be row-aligned")
+        if len(left.cell_id) and not np.array_equal(left.cell_id,
+                                                    right.cell_id):
+            raise ValueError("chips must be matched on the same cell ids")
+        increments = []
+        for i in range(len(left.cell_id)):
+            lc, rc = bool(left.is_core[i]), bool(right.is_core[i])
+            if lc and rc:
+                cellg = self.grid_boundary(left.cell_id[i:i + 1])
+                increments.append(geometry_rings(cellg, 0))
+            elif lc:
+                increments.append(geometry_rings(right.geoms, i))
+            elif rc:
+                increments.append(geometry_rings(left.geoms, i))
+            else:
+                increments.append(rings_boolean(
+                    geometry_rings(left.geoms, i),
+                    geometry_rings(right.geoms, i), "intersection"))
+        return rings_to_array(unary_union_rings(increments))
+
+    def st_union_agg(self, chips: ChipSet) -> Geoms:
+        """Union of all chip geometries (core chips contribute their whole
+        cell) — reference: ST_UnionAgg."""
+        from ..core.geometry.clip import (geometry_rings, rings_to_array,
+                                          unary_union_rings)
+        regions = []
+        for i in range(len(chips.cell_id)):
+            if bool(chips.is_core[i]):
+                cellg = self.grid_boundary(chips.cell_id[i:i + 1])
+                regions.append(geometry_rings(cellg, 0))
+            else:
+                regions.append(geometry_rings(chips.geoms, i))
+        return rings_to_array(unary_union_rings(regions))
+
+    def st_intersects_agg(self, left: ChipSet, right: ChipSet) -> bool:
+        """True if any cell-matched chip pair intersects (reference:
+        ST_IntersectsAgg — the cheap existence version)."""
+        if len(left.cell_id) != len(right.cell_id) or \
+                not np.array_equal(left.cell_id, right.cell_id):
+            raise ValueError("chips must be matched on the same cell ids")
+        if len(left.cell_id) == 0:
+            return False
+        if np.any(left.is_core) or np.any(right.is_core):
+            return True
+        # row-wise, one pair at a time — avoids the [N, N] pair matrix
+        for i in range(len(left.cell_id)):
+            one = self.st_intersects(left.geoms.take([i]),
+                                     right.geoms.take([i]))
+            if bool(one[0]):
+                return True
+        return False
+
     def st_dump(self, g: Geoms) -> Geoms:
         """Explode multi-geometries into singles (reference:
         FlattenPolygons / st_dump)."""
@@ -402,9 +502,128 @@ class MosaicContext:
         return ChipSet(np.arange(len(cells)), cells,
                        np.full(len(cells), is_core), self.grid_boundary(cells))
 
+    def grid_cell_intersection(self, a: ChipSet, b: ChipSet) -> ChipSet:
+        """Row-wise chip∩chip on matching cell ids.  Core shortcut: a core
+        chip is the whole cell, so the intersection is the other chip
+        (reference: CellIntersection.nullSafeEval)."""
+        return self._cell_combine(a, b, "intersection")
+
+    def grid_cell_union(self, a: ChipSet, b: ChipSet) -> ChipSet:
+        """Row-wise chip∪chip on matching cell ids.  Either chip core ⇒
+        result is the core chip (reference: CellUnion.nullSafeEval)."""
+        return self._cell_combine(a, b, "union")
+
+    def _cell_combine(self, a: ChipSet, b: ChipSet, op: str) -> ChipSet:
+        from ..core.geometry.clip import (geometry_rings, rings_boolean,
+                                          rings_to_array)
+        if len(a.cell_id) != len(b.cell_id) or \
+                not np.array_equal(a.cell_id, b.cell_id):
+            raise ValueError(
+                f"can only {op} chips with the same grid cell id")
+        builder = GeometryBuilder(srid=a.geoms.srid)
+        is_core = np.zeros(len(a.cell_id), bool)
+        for i in range(len(a.cell_id)):
+            ac, bc = bool(a.is_core[i]), bool(b.is_core[i])
+            if op == "intersection":
+                if ac:
+                    is_core[i] = bc
+                    rings = geometry_rings(b.geoms, i)
+                elif bc:
+                    rings = geometry_rings(a.geoms, i)
+                else:
+                    rings = rings_boolean(geometry_rings(a.geoms, i),
+                                          geometry_rings(b.geoms, i),
+                                          "intersection")
+            else:
+                if ac or bc:
+                    is_core[i] = True
+                    rings = geometry_rings(
+                        self.grid_boundary(a.cell_id[i:i + 1]), 0)
+                else:
+                    rings = rings_boolean(geometry_rings(a.geoms, i),
+                                          geometry_rings(b.geoms, i),
+                                          "union")
+            rings_to_array(rings, builder=builder)
+        return ChipSet(a.geom_id.copy(), a.cell_id.copy(), is_core,
+                       builder.finish())
+
+    def grid_cell_intersection_agg(self, chips: ChipSet) -> ChipSet:
+        """Per distinct cell id, the intersection of every chip on that
+        cell (reference: CellIntersectionAgg)."""
+        return self._cell_agg(chips, "intersection")
+
+    def grid_cell_union_agg(self, chips: ChipSet) -> ChipSet:
+        """Per distinct cell id, the union of every chip on that cell
+        (reference: CellUnionAgg)."""
+        return self._cell_agg(chips, "union")
+
+    def _cell_agg(self, chips: ChipSet, op: str) -> ChipSet:
+        from ..core.geometry.clip import (geometry_rings, rings_boolean,
+                                          rings_to_array,
+                                          unary_union_rings)
+        cells = np.unique(chips.cell_id)
+        builder = GeometryBuilder(srid=chips.geoms.srid)
+        is_core = np.zeros(len(cells), bool)
+        for ci, cell in enumerate(cells):
+            rows = np.nonzero(chips.cell_id == cell)[0]
+            cores = chips.is_core[rows]
+            if op == "union" and np.any(cores):
+                is_core[ci] = True
+                rings = geometry_rings(self.grid_boundary(cell[None]), 0)
+            elif op == "union":
+                rings = unary_union_rings(
+                    [geometry_rings(chips.geoms, int(r)) for r in rows])
+            else:
+                border = [int(r) for r in rows if not chips.is_core[r]]
+                if not border:
+                    is_core[ci] = True
+                    rings = geometry_rings(self.grid_boundary(cell[None]),
+                                           0)
+                else:
+                    rings = geometry_rings(chips.geoms, border[0])
+                    for r in border[1:]:
+                        rings = rings_boolean(
+                            rings, geometry_rings(chips.geoms, r),
+                            "intersection")
+            rings_to_array(rings, builder=builder)
+        return ChipSet(np.arange(len(cells)), cells, is_core,
+                       builder.finish())
+
     # id formatting (reference: IndexSystem.formatCellId :48-74)
     def grid_cellid_to_string(self, cells) -> List[str]:
         return self.index_system.format_cell_id(np.asarray(cells, np.int64))
 
     def grid_cellid_from_string(self, strings) -> np.ndarray:
         return self.index_system.parse_cell_id(strings)
+
+
+def _auto_register() -> None:
+    """Register every public st_/grid_/rst_ method in the function
+    registry so ``ctx.function_names()`` is the live parity checklist
+    against the reference's ~150-name surface
+    (functions/MosaicContext.scala:114-558)."""
+    from .registry import register
+    legacy = {"mosaic_explode", "mosaicfill", "point_index_geom",
+              "point_index_lonlat", "index_geometry"}
+    for name in dir(MosaicContext):
+        if name.startswith("_"):
+            continue
+        fn = getattr(MosaicContext, name)
+        if not callable(fn):
+            continue
+        if name.endswith("_agg"):
+            group = "aggregator"
+        elif name.startswith("st_"):
+            group = "geometry"
+        elif name.startswith("grid_"):
+            group = "grid"
+        elif name.startswith("rst_"):
+            group = "raster"
+        elif name in legacy:
+            group = "legacy"
+        else:
+            continue
+        register(name, group)(fn)
+
+
+_auto_register()
