@@ -1,0 +1,377 @@
+"""Raster operators — the compute layer over RasterTile.
+
+Reference counterpart: core/raster/operator/* (clip/RasterClipByVector,
+merge/MergeRasters, pixel/PixelCombineRasters, retile/RasterTessellate,
+retile/BalancedSubdivision, retile/ReTile, separate/SeparateBands,
+CombineAVG, gdal/GDALWarp.scala) — each of which shells into GDAL C++.
+Here every op is dense array math: numpy on host for ragged assembly,
+jnp for the pixel-parallel inner ops so the same code jits on TPU
+(elementwise fuses into neighbouring ops under XLA).
+
+Alignment model: ops that combine tiles require compatible grids (same
+pixel size & phase); merge/combine resample nothing — like the
+reference's MergeRasters, which assumes pre-projected tiles (the
+RasterAsGridReader pipeline projects first, :34).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..geometry.array import GeometryArray
+from ..index.base import IndexSystem
+from ..tessellate import _pip, _poly_edges
+from .tile import GeoTransform, RasterTile
+
+__all__ = ["clip_to_geometry", "clip_to_cell", "merge", "combine",
+           "combine_avg", "tessellate_raster", "retile", "subdivide",
+           "separate_bands", "ndvi", "convolve", "filter_tile",
+           "map_algebra", "resample"]
+
+
+_F = np.float64
+
+
+def _nodata_fill(tile: RasterTile) -> float:
+    nd = tile.nodata
+    if nd is None:
+        return float("nan")
+    return float(nd if np.ndim(nd) == 0 else nd[0])
+
+
+def _mask_fill(win: RasterTile, inside: np.ndarray) -> RasterTile:
+    """Nodata-fill pixels outside ``inside`` ([H, W] bool), handling the
+    integer-dtype-without-nodata case (falls back to 0)."""
+    fill = _nodata_fill(win)
+    data = np.asarray(win.data).copy()
+    if data.dtype.kind in "ui" and math.isnan(fill):
+        fill = 0.0
+        win = dataclasses.replace(win, nodata=0.0)
+    data[:, ~inside] = np.asarray(fill, dtype=data.dtype) \
+        if not math.isnan(fill) else np.nan
+    return win.with_data(data)
+
+
+def clip_to_geometry(tile: RasterTile, geom: GeometryArray,
+                     gi: int = 0) -> RasterTile:
+    """Crop to the geometry bbox and nodata-mask pixels whose center
+    falls outside the geometry (reference:
+    operator/clip/RasterClipByVector.scala:73 — GDALWarp cutline with
+    CENTER pixel test)."""
+    edges = _poly_edges(geom, gi)
+    if len(edges) == 0:
+        return tile.window(0, 0, 0, 0)
+    xmin, ymin = edges[:, :, 0].min(), edges[:, :, 1].min()
+    xmax, ymax = edges[:, :, 0].max(), edges[:, :, 1].max()
+    c0, r0 = tile.gt.to_raster(xmin, ymax)   # north-up: ymax is top
+    c1, r1 = tile.gt.to_raster(xmax, ymin)
+    col0 = int(np.floor(min(c0, c1)))
+    col1 = int(np.ceil(max(c0, c1)))
+    row0 = int(np.floor(min(r0, r1)))
+    row1 = int(np.ceil(max(r0, r1)))
+    col0 = max(col0, 0)
+    row0 = max(row0, 0)
+    col1 = min(col1, tile.width)
+    row1 = min(row1, tile.height)
+    if col1 <= col0 or row1 <= row0:
+        return tile.window(0, 0, 0, 0)
+    win = tile.window(col0, row0, col1 - col0, row1 - row0)
+    xs, ys = win.pixel_centers()
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=-1)
+    inside = _pip(pts, edges).reshape(win.height, win.width)
+    return _mask_fill(win, inside)
+
+
+def clip_to_cell(tile: RasterTile, cell_id: int,
+                 grid: IndexSystem) -> RasterTile:
+    """Clip to one grid cell (reference:
+    MosaicRasterGDAL.getRasterForCell:393).
+
+    Pixel ownership is ``point_to_cell(center) == cell_id`` — NOT a ring
+    PIP test — so a pixel whose center sits exactly on a cell boundary
+    goes to the same cell the vector/point path assigns it to, and
+    tessellated tiles partition the raster with no double-counted or
+    dropped boundary pixels."""
+    cell = np.asarray([cell_id], np.int64)
+    res = int(grid.resolution_of(cell)[0])
+    verts, counts = grid.cell_boundary(cell)
+    ring = verts[0, :counts[0]]
+    xmin, ymin = ring[:, 0].min(), ring[:, 1].min()
+    xmax, ymax = ring[:, 0].max(), ring[:, 1].max()
+    c0, r0 = tile.gt.to_raster(xmin, ymax)
+    c1, r1 = tile.gt.to_raster(xmax, ymin)
+    col0 = max(int(np.floor(min(c0, c1))) - 1, 0)
+    row0 = max(int(np.floor(min(r0, r1))) - 1, 0)
+    col1 = min(int(np.ceil(max(c0, c1))) + 1, tile.width)
+    row1 = min(int(np.ceil(max(r0, r1))) + 1, tile.height)
+    if col1 <= col0 or row1 <= row0:
+        out = tile.window(0, 0, 0, 0)
+        return dataclasses.replace(out, cell_id=int(cell_id))
+    win = tile.window(col0, row0, col1 - col0, row1 - row0)
+    xs, ys = win.pixel_centers()
+    # Ownership must not depend on which sub-window frame recomputed the
+    # center: windowing shifts centers by ~1e-15 relative, which flips
+    # floor() for pixels exactly on a cell boundary.  A +1e-6-pixel nudge
+    # dominates that ulp noise, so every frame agrees (boundary pixels go
+    # to the upper cell, matching point_to_cell's half-open convention).
+    nx = abs(tile.gt.px_w) * 1e-6
+    ny = abs(tile.gt.px_h) * 1e-6
+    pts = np.stack([xs.ravel() + nx, ys.ravel() + ny], axis=-1)
+    own = grid.point_to_cell(pts, res) == cell_id
+    inside = own.reshape(win.height, win.width)
+    out = _mask_fill(win, inside)
+    return dataclasses.replace(out, cell_id=int(cell_id))
+
+
+def _common_grid(tiles: Sequence[RasterTile]
+                 ) -> Tuple[GeoTransform, int, int]:
+    g0 = tiles[0].gt
+    for t in tiles[1:]:
+        if not (np.isclose(t.gt.px_w, g0.px_w) and
+                np.isclose(t.gt.px_h, g0.px_h) and
+                t.gt.rot_x == 0 and t.gt.rot_y == 0):
+            raise ValueError("merge/combine requires equal pixel grids "
+                             "(project/resample first)")
+        # same phase too: origin offsets must be whole pixels, else
+        # _paste_coords' rounding silently misregisters the tile
+        ox = (t.gt.x0 - g0.x0) / g0.px_w
+        oy = (t.gt.y0 - g0.y0) / g0.px_h
+        if abs(ox - round(ox)) > 1e-6 or abs(oy - round(oy)) > 1e-6:
+            raise ValueError("merge/combine requires grid-phase-aligned "
+                             "tiles (origins offset by whole pixels); "
+                             "project/resample first")
+    xmin = min(t.bbox()[0] for t in tiles)
+    ymin = min(t.bbox()[1] for t in tiles)
+    xmax = max(t.bbox()[2] for t in tiles)
+    ymax = max(t.bbox()[3] for t in tiles)
+    gt = GeoTransform(xmin, g0.px_w, 0.0, ymax, 0.0, g0.px_h)
+    w = int(round((xmax - xmin) / g0.px_w))
+    h = int(round((ymax - ymin) / -g0.px_h))
+    return gt, h, w
+
+
+def _paste_coords(t: RasterTile, gt: GeoTransform) -> Tuple[int, int]:
+    c, r = gt.to_raster(t.gt.x0, t.gt.y0)
+    return int(round(c)), int(round(r))
+
+
+def merge(tiles: Sequence[RasterTile]) -> RasterTile:
+    """Mosaic aligned tiles; later tiles win where valid (reference:
+    operator/merge/MergeRasters via gdalwarp)."""
+    tiles = list(tiles)
+    if not tiles:
+        raise ValueError("merge of zero tiles")
+    gt, h, w = _common_grid(tiles)
+    bands = max(t.num_bands for t in tiles)
+    out = np.full((bands, h, w), np.nan, _F)
+    for t in tiles:
+        c0, r0 = _paste_coords(t, gt)
+        d = np.asarray(t.data, _F)
+        m = t.valid_mask()
+        sub = out[:t.num_bands, r0:r0 + t.height, c0:c0 + t.width]
+        sub[m] = d[m]
+    nd = _nodata_fill(tiles[0])
+    if not math.isnan(nd):
+        out = np.where(np.isnan(out), nd, out)
+    return RasterTile(out, gt, nodata=tiles[0].nodata,
+                      srid=tiles[0].srid, meta={"op": "merge"})
+
+
+def combine(tiles: Sequence[RasterTile], reducer: str = "avg"
+            ) -> RasterTile:
+    """Per-pixel reduction across aligned overlapping tiles (reference:
+    pixel/PixelCombineRasters.scala / CombineAVG.scala).  reducer in
+    {avg, min, max, median, count, sum}."""
+    tiles = list(tiles)
+    if not tiles:
+        raise ValueError("combine of zero tiles")
+    gt, h, w = _common_grid(tiles)
+    bands = max(t.num_bands for t in tiles)
+    stack = np.full((len(tiles), bands, h, w), np.nan, _F)
+    for i, t in enumerate(tiles):
+        c0, r0 = _paste_coords(t, gt)
+        d = np.where(t.valid_mask(), np.asarray(t.data, _F), np.nan)
+        stack[i, :t.num_bands, r0:r0 + t.height, c0:c0 + t.width] = d
+    import jax.numpy as jnp
+    s = jnp.asarray(stack)
+    with np.errstate(all="ignore"):
+        if reducer == "avg":
+            out = jnp.nanmean(s, axis=0)
+        elif reducer == "min":
+            out = jnp.nanmin(s, axis=0)
+        elif reducer == "max":
+            out = jnp.nanmax(s, axis=0)
+        elif reducer == "median":
+            out = jnp.nanmedian(s, axis=0)
+        elif reducer == "sum":
+            out = jnp.nansum(s, axis=0)
+        elif reducer == "count":
+            out = jnp.sum(~jnp.isnan(s), axis=0).astype(jnp.float64)
+        else:
+            raise ValueError(f"unknown reducer {reducer!r}")
+    return RasterTile(np.asarray(out), gt, nodata=None,
+                      srid=tiles[0].srid, meta={"op": f"combine_{reducer}"})
+
+
+def combine_avg(tiles: Sequence[RasterTile]) -> RasterTile:
+    return combine(tiles, "avg")
+
+
+def tessellate_raster(tile: RasterTile, res: int,
+                      grid: IndexSystem) -> List[RasterTile]:
+    """Raster → one clipped tile per covering grid cell (reference:
+    operator/retile/RasterTessellate.scala:30-57 — mosaicFill over the
+    raster bbox, then getRasterForCell per chip)."""
+    xmin, ymin, xmax, ymax = tile.bbox()
+    ring = np.array([[xmin, ymin], [xmax, ymin], [xmax, ymax],
+                     [xmin, ymax], [xmin, ymin]])
+    from ..geometry.array import GeometryBuilder
+    b = GeometryBuilder()
+    b.add_polygon(ring)
+    bbox_geom = b.finish()
+    from ..tessellate import tessellate as tessellate_vec
+    chips = tessellate_vec(bbox_geom, res, grid, keep_core_geom=False)
+    out = []
+    for cell in np.unique(chips.cell_id):
+        t = clip_to_cell(tile, int(cell), grid)
+        if t.width and t.height and not t.is_empty():
+            out.append(t)
+    return out
+
+
+def retile(tile: RasterTile, tile_w: int, tile_h: int) -> List[RasterTile]:
+    """Fixed-size grid retiling (reference: operator/retile/ReTile.scala)."""
+    out = []
+    for r0 in range(0, tile.height, tile_h):
+        for c0 in range(0, tile.width, tile_w):
+            t = tile.window(c0, r0, tile_w, tile_h)
+            if t.width and t.height:
+                out.append(t)
+    return out
+
+
+def subdivide(tile: RasterTile, size_mb: float) -> List[RasterTile]:
+    """Split recursively until every piece is under ``size_mb``
+    (reference: operator/retile/BalancedSubdivision.scala:92 — the
+    ingest-time memory bound, SURVEY P6)."""
+    limit = int(size_mb * (1 << 20))
+    if tile.memsize() <= limit or (tile.width <= 1 and tile.height <= 1):
+        return [tile]
+    halves = []
+    if tile.width >= tile.height:
+        m = tile.width // 2
+        halves = [tile.window(0, 0, m, tile.height),
+                  tile.window(m, 0, tile.width - m, tile.height)]
+    else:
+        m = tile.height // 2
+        halves = [tile.window(0, 0, tile.width, m),
+                  tile.window(0, m, tile.width, tile.height - m)]
+    out = []
+    for h in halves:
+        out.extend(subdivide(h, size_mb))
+    return out
+
+
+def separate_bands(tile: RasterTile) -> List[RasterTile]:
+    """reference: operator/separate/SeparateBands.scala"""
+    return [tile.band(b) for b in range(tile.num_bands)]
+
+
+def ndvi(tile: RasterTile, red_band: int, nir_band: int) -> RasterTile:
+    """(NIR - RED) / (NIR + RED) (reference: RST_NDVI via gdal_calc)."""
+    import jax.numpy as jnp
+    d = jnp.asarray(np.asarray(tile.data, _F))
+    red = d[red_band]
+    nir = d[nir_band]
+    denom = nir + red
+    out = jnp.where(denom == 0, jnp.nan, (nir - red) / denom)
+    m = tile.valid_mask()
+    out = jnp.where(jnp.asarray(m[red_band] & m[nir_band]), out, jnp.nan)
+    return RasterTile(np.asarray(out)[None], tile.gt, nodata=None,
+                      srid=tile.srid, meta={"op": "ndvi"})
+
+
+def convolve(tile: RasterTile, kernel: np.ndarray) -> RasterTile:
+    """2D convolution per band, zero-padded edges (reference:
+    MosaicRasterGDAL.convolve:312 / GDALBlock+Padding halo logic —
+    the halo is XLA's problem here)."""
+    import jax
+    import jax.numpy as jnp
+    k = jnp.asarray(np.asarray(kernel, _F))
+    d = jnp.asarray(np.where(tile.valid_mask(),
+                             np.asarray(tile.data, _F), 0.0))
+    out = jax.lax.conv_general_dilated(
+        d[:, None], k[None, None], window_strides=(1, 1),
+        padding="SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return RasterTile(np.asarray(out[:, 0]), tile.gt, nodata=None,
+                      srid=tile.srid, meta={"op": "convolve"})
+
+
+def filter_tile(tile: RasterTile, size: int, op: str) -> RasterTile:
+    """Sliding-window filter: avg/min/max/median/mode (reference:
+    MosaicRasterGDAL.filter:347)."""
+    if size % 2 != 1:
+        raise ValueError("filter size must be odd")
+    d = np.where(tile.valid_mask(), np.asarray(tile.data, _F), np.nan)
+    pad = size // 2
+    padded = np.pad(d, ((0, 0), (pad, pad), (pad, pad)),
+                    constant_values=np.nan)
+    windows = np.lib.stride_tricks.sliding_window_view(
+        padded, (size, size), axis=(1, 2))    # [B, H, W, s, s]
+    flat = windows.reshape(*windows.shape[:3], -1)
+    with np.errstate(all="ignore"):
+        if op == "avg":
+            out = np.nanmean(flat, axis=-1)
+        elif op == "min":
+            out = np.nanmin(flat, axis=-1)
+        elif op == "max":
+            out = np.nanmax(flat, axis=-1)
+        elif op == "median":
+            out = np.nanmedian(flat, axis=-1)
+        elif op == "mode":
+            def mode1(v):
+                v = v[~np.isnan(v)]
+                if v.size == 0:
+                    return np.nan
+                vals, cnt = np.unique(v, return_counts=True)
+                return vals[np.argmax(cnt)]
+            out = np.apply_along_axis(mode1, -1, flat)
+        else:
+            raise ValueError(f"unknown filter op {op!r}")
+    return RasterTile(out, tile.gt, nodata=None, srid=tile.srid,
+                      meta={"op": f"filter_{op}"})
+
+
+def map_algebra(tiles: Sequence[RasterTile],
+                fn: Callable) -> RasterTile:
+    """Elementwise band math over aligned tiles (reference:
+    gdal/GDALCalc.scala:32-58 — the python-subprocess gdal_calc; here a
+    jax-traceable function over the band arrays, so it fuses)."""
+    import jax.numpy as jnp
+    arrs = [jnp.asarray(np.where(t.valid_mask(),
+                                 np.asarray(t.data, _F), np.nan))
+            for t in tiles]
+    out = np.asarray(fn(*arrs))
+    if out.ndim == 2:
+        out = out[None]
+    return RasterTile(out, tiles[0].gt, nodata=None, srid=tiles[0].srid,
+                      meta={"op": "map_algebra"})
+
+
+def resample(tile: RasterTile, factor_x: float,
+             factor_y: float) -> RasterTile:
+    """Nearest-neighbour resample by scale factors (reference:
+    gdal/GDALTranslate-driven RST_UpdateType/size changes)."""
+    nh = max(1, int(round(tile.height * factor_y)))
+    nw = max(1, int(round(tile.width * factor_x)))
+    rr = np.clip((np.arange(nh) / factor_y).astype(int), 0,
+                 tile.height - 1)
+    cc = np.clip((np.arange(nw) / factor_x).astype(int), 0,
+                 tile.width - 1)
+    data = np.asarray(tile.data)[:, rr][:, :, cc]
+    return RasterTile(data, tile.gt.scaled(1.0 / factor_x, 1.0 / factor_y),
+                      nodata=tile.nodata, srid=tile.srid, meta=tile.meta)

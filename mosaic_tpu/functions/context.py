@@ -33,7 +33,10 @@ from ..types import ChipSet
 Geoms = GeometryArray
 
 
-class MosaicContext:
+from .raster import RasterFunctions
+
+
+class MosaicContext(RasterFunctions):
     """Bound (index system, geometry backend) + the function namespace."""
 
     _instance: Optional["MosaicContext"] = None

@@ -1,0 +1,92 @@
+"""The raster_to_grid pipeline: files → grid-cell measures.
+
+Reference counterpart: datasource/multiread/RasterAsGridReader.scala:36-110
+— spark.read.format("gdal") with retile_on_read → rst_asformat →
+rst_tessellate → groupBy(cell) → rst_combineavg_agg →
+rst_rastertogrid<combiner> → optional k-ring interpolation.
+
+TPU-first shape: the pipeline is a plain host function over tile lists;
+the per-cell combine is a segment-mean over the stacked pixel arrays
+(the P4 aggregation regime), and the result is a columnar
+(cell_id, measure) table ready to join against vector chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.index.base import IndexSystem
+from ..core.raster import rops
+from ..core.raster.gtiff import read_gtiff
+from ..core.raster.tile import RasterTile
+
+__all__ = ["raster_to_grid", "read_gtiff_files"]
+
+
+def read_gtiff_files(paths: Sequence[str],
+                     size_mb: Optional[float] = None) -> List[RasterTile]:
+    """GeoTIFF paths → tiles, optionally subdivided to a memory bound
+    (reference: GDALFileFormat + ReTileOnRead.localSubdivide)."""
+    tiles = []
+    for p in paths:
+        with open(p, "rb") as f:
+            t = read_gtiff(f.read())
+        t.meta["path"] = p
+        if size_mb is not None:
+            tiles.extend(rops.subdivide(t, size_mb))
+        else:
+            tiles.append(t)
+    return tiles
+
+
+def raster_to_grid(tiles: Sequence[RasterTile], res: int,
+                   grid: IndexSystem, combiner: str = "avg",
+                   band: int = 0,
+                   kring_interpolate: int = 0) -> Dict[int, float]:
+    """Tiles → {cell_id: combined measure} at grid resolution ``res``.
+
+    Stages mirror RasterAsGridReader.load (:52-110):
+      1. tessellate every tile to per-cell clipped tiles
+      2. group by cell id; combine overlapping tiles per cell (avg)
+      3. reduce each cell tile's valid band pixels by ``combiner``
+      4. optional k-ring smoothing: each cell value is replaced by the
+         mean of its k-ring neighbourhood values (:81-110 interpolation)
+    """
+    per_cell: Dict[int, List[RasterTile]] = {}
+    for t in tiles:
+        for ct in rops.tessellate_raster(t, res, grid):
+            per_cell.setdefault(int(ct.cell_id), []).append(ct)
+
+    out: Dict[int, float] = {}
+    for cell, group in per_cell.items():
+        tile = group[0] if len(group) == 1 else rops.combine_avg(group)
+        m = tile.valid_mask()[band]
+        if not m.any():
+            continue
+        v = np.asarray(tile.data[band], np.float64)[m]
+        if combiner == "avg":
+            out[cell] = float(v.mean())
+        elif combiner == "min":
+            out[cell] = float(v.min())
+        elif combiner == "max":
+            out[cell] = float(v.max())
+        elif combiner == "median":
+            out[cell] = float(np.median(v))
+        elif combiner == "count":
+            out[cell] = int(v.size)
+        else:
+            raise ValueError(f"unknown combiner {combiner!r}")
+
+    if kring_interpolate > 0 and out:
+        cells = np.asarray(sorted(out), np.int64)
+        vals = np.asarray([out[int(c)] for c in cells])
+        rings = grid.k_ring(cells, kring_interpolate)   # [N, K]
+        idx = {int(c): i for i, c in enumerate(cells)}
+        smoothed = {}
+        for i, c in enumerate(cells):
+            neigh = [idx[int(n)] for n in rings[i] if int(n) in idx]
+            smoothed[int(c)] = float(vals[neigh].mean())
+        out = smoothed
+    return out

@@ -1,0 +1,315 @@
+"""Raster subsystem: tile model, GeoTIFF codec, operators, pipeline.
+
+Mirrors the reference's raster test strategy (SURVEY.md §4: hermetic
+small synthetic fixtures, numpy oracles; reference fixtures live in
+src/test/resources/binary/).  BASELINE config 5 in miniature lives in
+TestRasterToGrid.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.index.custom import CustomIndexSystem, GridConf
+from mosaic_tpu.core.raster import (GeoTransform, RasterTile, read_gtiff,
+                                    write_gtiff)
+from mosaic_tpu.core.raster import rops
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.io.raster_grid import raster_to_grid
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MosaicContext.build("CUSTOM(0,16,0,16,2,1,1)")
+
+
+def dem_tile(rng, h=64, w=64, bands=1, nodata=-9999.0):
+    data = rng.uniform(0, 1000, (bands, h, w)).astype(np.float32)
+    gt = GeoTransform(0.0, 16.0 / w, 0.0, 16.0, 0.0, -16.0 / h)
+    return RasterTile(data, gt, nodata=nodata, srid=4326)
+
+
+class TestGeoTransform:
+    def test_world_raster_roundtrip(self, rng):
+        gt = GeoTransform(-74.3, 0.01, 0.0, 40.95, 0.0, -0.01)
+        cols = rng.uniform(0, 100, 50)
+        rows = rng.uniform(0, 100, 50)
+        x, y = gt.to_world(cols, rows)
+        c2, r2 = gt.to_raster(x, y)
+        np.testing.assert_allclose(c2, cols, atol=1e-9)
+        np.testing.assert_allclose(r2, rows, atol=1e-9)
+
+    def test_rotated_inverse(self):
+        gt = GeoTransform(10.0, 1.0, 0.2, 20.0, -0.1, -1.0)
+        x, y = gt.to_world(3.0, 7.0)
+        c, r = gt.to_raster(x, y)
+        assert c == pytest.approx(3.0) and r == pytest.approx(7.0)
+
+
+class TestCodec:
+    @pytest.mark.parametrize("dtype", [np.uint8, np.uint16, np.int16,
+                                       np.int32, np.float32, np.float64])
+    @pytest.mark.parametrize("compress", [False, True])
+    def test_roundtrip(self, rng, dtype, compress):
+        d = rng.uniform(0, 100, (2, 33, 47)).astype(dtype)
+        t = RasterTile(d, GeoTransform(-74.0, 1e-3, 0, 40.9, 0, -1e-3),
+                       nodata=7.0, srid=4326)
+        back = read_gtiff(write_gtiff(t, compress=compress))
+        assert np.array_equal(back.data, d)
+        assert back.gt.to_tuple() == pytest.approx(t.gt.to_tuple())
+        assert back.nodata == 7.0
+        assert back.srid == 4326
+
+    def test_projected_srid_roundtrip(self, rng):
+        d = rng.uniform(0, 10, (1, 8, 8)).astype(np.float32)
+        t = RasterTile(d, GeoTransform(0, 10, 0, 0, 0, -10), srid=27700)
+        assert read_gtiff(write_gtiff(t)).srid == 27700
+
+    def test_pil_interop(self, rng):
+        """Cross-decode TIFFs produced by an independent writer."""
+        from PIL import Image
+        arr = rng.uniform(0, 255, (21, 34)).astype(np.uint8)
+        for comp in (None, "tiff_deflate", "packbits"):
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="TIFF",
+                                      **({"compression": comp}
+                                         if comp else {}))
+            t = read_gtiff(buf.getvalue())
+            assert np.array_equal(t.data[0], arr), comp
+
+    def test_pil_predictor2_multiband(self, rng):
+        """Horizontal differencing must undo per component, not across
+        interleaved samples (regression)."""
+        from PIL import Image
+        arr = rng.integers(0, 255, (20, 30, 3)).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="TIFF",
+                                  compression="tiff_deflate",
+                                  tiffinfo={317: 2})
+        t = read_gtiff(buf.getvalue())
+        assert np.array_equal(np.moveaxis(t.data, 0, -1), arr)
+
+    def test_srid_out_of_geokey_range(self, rng):
+        t = dem_tile(rng, 4, 4)
+        import dataclasses
+        t = dataclasses.replace(t, srid=900913)
+        with pytest.raises(ValueError, match="SRID"):
+            write_gtiff(t)
+
+    def test_bad_input_raises(self):
+        with pytest.raises(ValueError, match="TIFF"):
+            read_gtiff(b"nope")
+        with pytest.raises(ValueError, match="truncated"):
+            read_gtiff(b"II")
+
+
+class TestTile:
+    def test_band_stats_respect_nodata(self, rng):
+        d = np.array([[[1.0, 2.0], [-9999.0, 3.0]]], np.float32)
+        t = RasterTile(d, GeoTransform(0, 1, 0, 0, 0, -1),
+                       nodata=-9999.0)
+        s = t.band_stats(0)
+        assert s["count"] == 3 and s["min"] == 1.0 and s["max"] == 3.0
+
+    def test_is_empty(self):
+        d = np.full((1, 4, 4), -1.0, np.float32)
+        t = RasterTile(d, GeoTransform(0, 1, 0, 0, 0, -1), nodata=-1.0)
+        assert t.is_empty()
+        assert not t.with_data(d + 1).is_empty()
+
+    def test_window_geotransform(self, rng):
+        t = dem_tile(rng)
+        w = t.window(8, 4, 16, 16)
+        # window's upper-left world coord == parent's pixel (8,4) coord
+        x, y = t.gt.to_world(8, 4)
+        assert w.gt.x0 == pytest.approx(x)
+        assert w.gt.y0 == pytest.approx(y)
+        assert np.array_equal(np.asarray(w.data),
+                              np.asarray(t.data)[:, 4:20, 8:24])
+
+    def test_band_out_of_range(self, rng):
+        with pytest.raises(IndexError):
+            dem_tile(rng).band(5)
+
+
+class TestOps:
+    def test_clip_to_cell_masks_outside(self, rng, ctx):
+        t = dem_tile(rng)
+        grid = ctx.index_system
+        cells = grid.candidate_cells(np.array([0, 0, 16, 16]), 2)
+        ct = rops.clip_to_cell(t, int(cells[5]), grid)
+        assert ct.cell_id == int(cells[5])
+        # all valid pixels' centers must fall inside the cell bbox
+        xs, ys = ct.pixel_centers()
+        m = ct.valid_mask()[0]
+        verts, counts = grid.cell_boundary(cells[5:6])
+        ring = verts[0, :counts[0]]
+        assert xs[m].min() >= ring[:, 0].min() - 1e-9
+        assert xs[m].max() <= ring[:, 0].max() + 1e-9
+        assert ys[m].min() >= ring[:, 1].min() - 1e-9
+        assert ys[m].max() <= ring[:, 1].max() + 1e-9
+
+    def test_tessellate_partitions_pixels(self, rng, ctx):
+        """Every pixel appears in exactly one cell tile (grid-aligned
+        raster ⇒ clean partition)."""
+        t = dem_tile(rng, 64, 64)
+        tiles = rops.tessellate_raster(t, 2, ctx.index_system)
+        total = sum(int(x.valid_mask().sum()) for x in tiles)
+        assert total == 64 * 64
+
+    def test_merge_and_combine(self, rng):
+        t = dem_tile(rng, 32, 32)
+        left = t.window(0, 0, 16, 32)
+        right = t.window(16, 0, 16, 32)
+        m = rops.merge([left, right])
+        np.testing.assert_allclose(np.asarray(m.data),
+                                   np.asarray(t.data, np.float64))
+        c = rops.combine([t, t.with_data(np.asarray(t.data) + 10)], "avg")
+        np.testing.assert_allclose(np.asarray(c.data),
+                                   np.asarray(t.data, np.float64) + 5)
+
+    def test_combine_reducers(self, rng):
+        t = dem_tile(rng, 8, 8)
+        t2 = t.with_data(np.asarray(t.data) + 10)
+        assert np.allclose(np.asarray(rops.combine([t, t2], "min").data),
+                           np.asarray(t.data, np.float64))
+        assert np.allclose(np.asarray(rops.combine([t, t2], "max").data),
+                           np.asarray(t.data, np.float64) + 10)
+        assert np.allclose(np.asarray(rops.combine([t, t2],
+                                                   "count").data), 2)
+
+    def test_ndvi_oracle(self, rng):
+        d = rng.uniform(1, 100, (2, 16, 16)).astype(np.float32)
+        t = RasterTile(d, GeoTransform(0, 1, 0, 16, 0, -1))
+        out = rops.ndvi(t, 0, 1)
+        red, nir = d[0].astype(np.float64), d[1].astype(np.float64)
+        np.testing.assert_allclose(np.asarray(out.data[0]),
+                                   (nir - red) / (nir + red), rtol=1e-12)
+
+    def test_convolve_box_oracle(self, rng):
+        d = rng.uniform(0, 10, (1, 12, 12)).astype(np.float64)
+        t = RasterTile(d, GeoTransform(0, 1, 0, 12, 0, -1))
+        k = np.ones((3, 3))
+        out = np.asarray(rops.convolve(t, k).data[0])
+        # interior pixel oracle
+        for (r, c) in [(5, 5), (3, 8)]:
+            assert out[r, c] == pytest.approx(
+                d[0, r - 1:r + 2, c - 1:c + 2].sum())
+
+    def test_filter_median(self, rng):
+        d = rng.uniform(0, 10, (1, 9, 9))
+        t = RasterTile(d, GeoTransform(0, 1, 0, 9, 0, -1))
+        out = np.asarray(rops.filter_tile(t, 3, "median").data[0])
+        assert out[4, 4] == pytest.approx(np.median(d[0, 3:6, 3:6]))
+
+    def test_subdivide_respects_bound(self, rng):
+        t = dem_tile(rng, 128, 128)
+        parts = rops.subdivide(t, 0.01)       # 10 KB bound
+        assert all(p.memsize() <= 0.01 * (1 << 20) for p in parts)
+        assert sum(p.width * p.height for p in parts) == 128 * 128
+
+    def test_retile_covers(self, rng):
+        t = dem_tile(rng, 50, 70)
+        parts = rops.retile(t, 32, 32)
+        assert sum(p.width * p.height for p in parts) == 50 * 70
+
+
+class TestRstSurface:
+    def test_accessors(self, rng, ctx):
+        t = dem_tile(rng, 32, 48, bands=2)
+        assert ctx.rst_height([t])[0] == 32
+        assert ctx.rst_width([t])[0] == 48
+        assert ctx.rst_numbands([t])[0] == 2
+        assert ctx.rst_scalex([t])[0] == pytest.approx(16.0 / 48)
+        assert ctx.rst_srid([t])[0] == 4326
+        assert ctx.rst_pixelcount([t])[0] == 2 * 32 * 48
+        assert not ctx.rst_isempty([t])[0]
+
+    def test_write_read_surface(self, rng, ctx):
+        t = dem_tile(rng, 16, 16)
+        blobs = ctx.rst_write([t])
+        assert ctx.rst_tryopen(blobs) == [True]
+        assert ctx.rst_tryopen([b"junk"]) == [False]
+        back = ctx.rst_fromcontent(blobs)[0]
+        np.testing.assert_array_equal(np.asarray(back.data),
+                                      np.asarray(t.data))
+
+    def test_frombands_separatebands(self, rng, ctx):
+        t = dem_tile(rng, 8, 8, bands=3)
+        bands = ctx.rst_separatebands([t])
+        assert len(bands) == 3
+        back = ctx.rst_frombands(bands)
+        np.testing.assert_array_equal(np.asarray(back.data),
+                                      np.asarray(t.data))
+
+    def test_rastertogrid_oracle(self, rng, ctx):
+        t = dem_tile(rng, 64, 64)
+        got = ctx.rst_rastertogridavg([t], 2)[0]
+        xs, ys = t.pixel_centers()
+        cells = ctx.index_system.point_to_cell(
+            np.stack([xs.ravel(), ys.ravel()], -1), 2)
+        vals = np.asarray(t.data[0], np.float64).ravel()
+        for c in np.unique(cells):
+            assert got[int(c)] == pytest.approx(vals[cells == c].mean(),
+                                                rel=1e-12)
+
+    def test_world_coord_surface(self, rng, ctx):
+        t = dem_tile(rng)
+        xy = ctx.rst_rastertoworldcoord([t], [0], [0])
+        assert xy[0, 0] == pytest.approx(0.0)
+        assert xy[0, 1] == pytest.approx(16.0)
+        cr = ctx.rst_worldtorastercoord([t], [8.0], [8.0])
+        assert cr[0, 0] == 32 and cr[0, 1] == 32
+
+
+class TestRasterToGrid:
+    def test_pipeline_matches_oracle(self, rng, ctx):
+        """BASELINE config 5 in miniature: synthetic DEM → grid measures,
+        vs direct per-cell pixel binning."""
+        t = dem_tile(rng, 64, 64)
+        got = raster_to_grid([t], 2, ctx.index_system, "avg")
+        xs, ys = t.pixel_centers()
+        cells = ctx.index_system.point_to_cell(
+            np.stack([xs.ravel(), ys.ravel()], -1), 2)
+        vals = np.asarray(t.data[0], np.float64).ravel()
+        assert set(got) == set(int(c) for c in np.unique(cells))
+        for c in np.unique(cells):
+            assert got[int(c)] == pytest.approx(vals[cells == c].mean(),
+                                                rel=1e-9)
+
+    def test_pipeline_overlapping_tiles(self, rng, ctx):
+        """Two overlapping tiles: per-cell combine averages them."""
+        t = dem_tile(rng, 32, 32)
+        t2 = t.with_data(np.asarray(t.data) + 100)
+        got = raster_to_grid([t, t2], 2, ctx.index_system, "avg")
+        solo = raster_to_grid([t], 2, ctx.index_system, "avg")
+        for c, v in solo.items():
+            # t2's +100 rounds in its float32 storage before combining
+            assert got[c] == pytest.approx(v + 50, rel=1e-5)
+
+    def test_subdivision_invariance(self, rng, ctx):
+        """raster_to_grid over subdivided halves == over the whole
+        raster, even when pixel centers align exactly with cell
+        boundaries (the windowed-frame ulp tie regression)."""
+        dem = rng.uniform(0, 500, (1, 96, 96)).astype(np.float32)
+        t = RasterTile(dem, GeoTransform(0.0, 16 / 96, 0, 16.0, 0,
+                                         -16 / 96), nodata=-1.0)
+        whole = raster_to_grid([t], 2, ctx.index_system, "avg")
+        halves = rops.subdivide(t, 0.02)
+        assert len(halves) > 1
+        split = raster_to_grid(halves, 2, ctx.index_system, "avg")
+        assert set(whole) == set(split)
+        for c, v in whole.items():
+            assert split[c] == pytest.approx(v, rel=1e-12)
+
+    def test_kring_interpolation(self, rng, ctx):
+        # 64×64 px over a 64×64-cell grid: every cell carries a value,
+        # so each 1-ring has 9 valued members and smoothing contracts
+        t = dem_tile(rng, 64, 64)
+        plain = raster_to_grid([t], 2, ctx.index_system, "avg")
+        smooth = raster_to_grid([t], 2, ctx.index_system, "avg",
+                                kring_interpolate=1)
+        assert set(plain) == set(smooth)
+        # smoothing shrinks the value spread
+        assert np.std(list(smooth.values())) < np.std(list(plain.values()))
