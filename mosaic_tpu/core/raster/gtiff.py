@@ -122,12 +122,20 @@ def _undo_predictor(arr: np.ndarray, predictor: int) -> np.ndarray:
 
 def _epsg_from_geokeys(entry, bo: str) -> Optional[int]:
     vals = _values(entry, bo)
-    # GeoKeyDirectory: header of 4 shorts then (key, loc, cnt, value)*
+    # GeoKeyDirectory: header of 4 shorts then (key, loc, cnt, value)*.
+    # A projected raster commonly carries BOTH ProjectedCSTypeGeoKey
+    # (3072) and the underlying GeographicTypeGeoKey (2048); the
+    # projected code governs the pixel coordinates, so it wins.
+    geographic = projected = None
     for i in range(4, len(vals) - 3, 4):
         key, loc, cnt, val = vals[i:i + 4]
-        if key in (2048, 3072) and loc == 0:       # Geographic / Projected
-            return int(val)
-    return None
+        if loc != 0:
+            continue
+        if key == 3072:
+            projected = int(val)
+        elif key == 2048:
+            geographic = int(val)
+    return projected if projected is not None else geographic
 
 
 def read_gtiff(data: bytes) -> RasterTile:
@@ -342,9 +350,17 @@ def write_gtiff(tile: RasterTile, compress: bool = False) -> bytes:
             1025, 0, 1, 1,
             2048 if geographic else 3072, 0, 1, tile.srid]
     e(_TAG_GEO_KEYS, 3, keys, "H")
-    if tile.nodata is not None and np.ndim(tile.nodata) == 0:
-        e(_TAG_GDAL_NODATA, 2,
-          str(float(tile.nodata)).encode() + b"\x00", "s")
+    if tile.nodata is not None:
+        nd = tile.nodata
+        if np.ndim(nd) != 0:
+            uniq = set(float(v) for v in nd if v is not None)
+            if len(uniq) != 1 or any(v is None for v in nd):
+                raise ValueError(
+                    "GeoTIFF carries one GDAL_NODATA value per file; "
+                    f"per-band nodata {nd!r} differs — unify with "
+                    "rst_setnodata first")
+            nd = uniq.pop()
+        e(_TAG_GDAL_NODATA, 2, str(float(nd)).encode() + b"\x00", "s")
 
     # placeholder offsets; two passes to fix layout
     e(_TAG_STRIP_OFFSETS, 4, [0] * n_strips, "I")

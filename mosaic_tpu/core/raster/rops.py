@@ -130,6 +130,9 @@ def clip_to_cell(tile: RasterTile, cell_id: int,
 def _common_grid(tiles: Sequence[RasterTile]
                  ) -> Tuple[GeoTransform, int, int]:
     g0 = tiles[0].gt
+    if g0.rot_x or g0.rot_y:
+        raise ValueError("merge/combine requires north-up tiles "
+                         "(project/resample first)")
     for t in tiles[1:]:
         if not (np.isclose(t.gt.px_w, g0.px_w) and
                 np.isclose(t.gt.px_h, g0.px_h) and

@@ -1,0 +1,209 @@
+"""BNGIndexSystem + the grid backend matrix.
+
+Mirrors the reference's backend-matrix idea
+(test/MosaicSpatialQueryTest.scala:17-131: every engine test runs across
+index systems) and BNGIndexSystemTest behaviors: id encoding, string
+round-trip, quadrant resolutions, kRing/kLoop, polyfill over the engine.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry.wkt import read_wkt
+from mosaic_tpu.core.index.bng import BNGIndexSystem
+from mosaic_tpu.core.index.factory import get_index_system
+from mosaic_tpu.core.tessellate import tessellate, polyfill
+
+
+@pytest.fixture(scope="module")
+def bng():
+    return BNGIndexSystem()
+
+
+class TestIds:
+    def test_known_grid_reference(self, bng):
+        """OSGB: E=538000, N=177000 lies in TQ (London)."""
+        ids = bng.point_to_cell(np.array([[538000.0, 177000.0]]), 1)
+        assert bng.format_cell_id(ids)[0] == "TQ"
+        ids4 = bng.point_to_cell(np.array([[538123.0, 177987.0]]), 4)
+        # 100m res: eBin=381, nBin=779 from (38123, 77987)
+        assert bng.format_cell_id(ids4)[0] == "TQ381779"
+
+    def test_quadrant_strings(self, bng):
+        # 500m resolution = quadrant of the 1km cell
+        pts = np.array([[538100.0, 177100.0],    # SW of km cell
+                        [538100.0, 177900.0],    # NW
+                        [538900.0, 177900.0],    # NE
+                        [538900.0, 177100.0]])   # SE
+        ids = bng.point_to_cell(pts, -4)
+        names = bng.format_cell_id(ids)
+        assert names == ["TQ3877SW", "TQ3877NW", "TQ3877NE", "TQ3877SE"]
+
+    def test_res_minus_one_blocks(self, bng):
+        """500km blocks S,T,N,O,H,J round-trip and decode distinctly
+        (the reference's own res −1 encode is lossy — see _encode)."""
+        pts = np.array([[100.0, 100.0], [600_000.0, 100.0],
+                        [100.0, 600_000.0], [600_000.0, 600_000.0],
+                        [100.0, 1_100_000.0], [600_000.0, 1_100_000.0]])
+        ids = bng.point_to_cell(pts, -1)
+        assert len(set(ids.tolist())) == 6
+        names = bng.format_cell_id(ids)
+        assert names == ["S", "T", "N", "O", "H", "J"]
+        np.testing.assert_array_equal(bng.parse_cell_id(names), ids)
+        c = bng.cell_center(ids)
+        assert np.all(bng.point_to_cell(c, -1) == ids)
+        assert np.all(bng.is_valid_cell(ids))
+        import jax.numpy as jnp
+        np.testing.assert_array_equal(
+            np.asarray(bng.point_to_cell_jax(jnp.asarray(pts), -1)), ids)
+
+    @pytest.mark.parametrize("res", [1, 2, 3, 4, 5, 6, -2, -3, -4, -5,
+                                     -6])
+    def test_roundtrip_ids(self, bng, rng, res):
+        pts = np.stack([rng.uniform(0, 700_000, 200),
+                        rng.uniform(0, 1_300_000, 200)], -1)
+        ids = bng.point_to_cell(pts, res)
+        assert np.all(bng.resolution_of(ids) == res)
+        back = bng.parse_cell_id(bng.format_cell_id(ids))
+        np.testing.assert_array_equal(back, ids)
+
+    @pytest.mark.parametrize("res", [1, 3, 4, -2, -4, -6])
+    def test_center_in_cell_and_containment(self, bng, rng, res):
+        pts = np.stack([rng.uniform(0, 700_000, 100),
+                        rng.uniform(0, 1_300_000, 100)], -1)
+        ids = bng.point_to_cell(pts, res)
+        verts, counts = bng.cell_boundary(ids)
+        assert np.all(counts == 4)
+        # each source point inside its own cell square
+        x0 = verts[:, 0, 0]
+        y0 = verts[:, 0, 1]
+        x1 = verts[:, 2, 0]
+        y1 = verts[:, 2, 1]
+        assert np.all((pts[:, 0] >= x0) & (pts[:, 0] < x1))
+        assert np.all((pts[:, 1] >= y0) & (pts[:, 1] < y1))
+        c = bng.cell_center(ids)
+        assert np.all(bng.point_to_cell(c, res) == ids)
+
+    def test_edge_sizes(self, bng):
+        assert bng.edge_size(1) == 100_000
+        assert bng.edge_size(6) == 1
+        assert bng.edge_size(-1) == 500_000
+        assert bng.edge_size(-4) == 500
+        assert bng.cell_area(np.array([
+            bng.point_to_cell(np.array([[1000.0, 1000.0]]), 3)[0]
+        ]))[0] == pytest.approx(1_000_000.0)
+
+    def test_jax_kernel_matches_host(self, bng, rng):
+        import jax.numpy as jnp
+        pts = np.stack([rng.uniform(0, 700_000, 500),
+                        rng.uniform(0, 1_300_000, 500)], -1)
+        for res in (2, 4, -3, -5):
+            host = bng.point_to_cell(pts, res)
+            dev = np.asarray(bng.point_to_cell_jax(jnp.asarray(pts), res))
+            np.testing.assert_array_equal(host, dev)
+
+    def test_invalid_res(self, bng):
+        with pytest.raises(ValueError, match="resolution"):
+            bng.point_to_cell(np.array([[0.0, 0.0]]), 0)
+        with pytest.raises(ValueError, match="resolution"):
+            bng.point_to_cell(np.array([[0.0, 0.0]]), 9)
+
+    def test_parse_errors(self, bng):
+        with pytest.raises(ValueError, match="letter pair"):
+            bng.parse_cell_id(["ZZ12"])
+
+
+class TestNeighbours:
+    def test_k_ring_counts(self, bng):
+        c = bng.point_to_cell(np.array([[350_000.0, 650_000.0]]), 3)
+        ring = bng.k_ring(c, 1)
+        assert (ring[0] >= 0).sum() == 9
+        ring2 = bng.k_ring(c, 2)
+        assert (ring2[0] >= 0).sum() == 25
+
+    def test_k_loop_counts(self, bng):
+        c = bng.point_to_cell(np.array([[350_000.0, 650_000.0]]), 3)
+        loop = bng.k_loop(c, 1)
+        assert (loop[0] >= 0).sum() == 8
+        loop2 = bng.k_loop(c, 2)
+        assert (loop2[0] >= 0).sum() == 16
+
+    def test_edge_of_domain_truncates(self, bng):
+        c = bng.point_to_cell(np.array([[500.0, 500.0]]), 3)  # SW corner
+        ring = bng.k_ring(c, 1)
+        assert (ring[0] >= 0).sum() == 4    # only NE quadrant exists
+
+    def test_grid_distance(self, bng):
+        a = bng.point_to_cell(np.array([[100_500.0, 100_500.0]]), 3)
+        b = bng.point_to_cell(np.array([[103_500.0, 101_500.0]]), 3)
+        assert bng.grid_distance(a, b)[0] == 3
+
+
+GRIDS = [
+    ("BNG", 3, (100_000, 100_000, 200_000, 200_000)),
+    ("CUSTOM(0,16,0,16,2,1,1)", 2, (0, 0, 16, 16)),
+    ("H3", 7, (-74.1, 40.6, -73.9, 40.8)),
+]
+
+
+@pytest.mark.parametrize("name,res,domain", GRIDS,
+                         ids=[g[0].split("(")[0] for g in GRIDS])
+class TestBackendMatrix:
+    """Same engine assertions across all three grids (reference:
+    MosaicSpatialQueryTest backend matrix)."""
+
+    def _poly(self, domain):
+        x0, y0, x1, y1 = domain
+        w, h = x1 - x0, y1 - y0
+        ring = [(x0 + 0.2 * w, y0 + 0.2 * h), (x0 + 0.8 * w, y0 + 0.25 * h),
+                (x0 + 0.7 * w, y0 + 0.8 * h), (x0 + 0.4 * w, y0 + 0.6 * h),
+                (x0 + 0.2 * w, y0 + 0.75 * h), (x0 + 0.2 * w, y0 + 0.2 * h)]
+        wkt = "POLYGON((" + ", ".join(f"{x} {y}" for x, y in ring) + "))"
+        return read_wkt([wkt])
+
+    def test_tessellate_core_border(self, name, res, domain):
+        grid = get_index_system(name)
+        polys = self._poly(domain)
+        chips = tessellate(polys, res, grid)
+        assert len(chips.cell_id) > 10
+        assert chips.is_core.sum() > 0
+        assert (~chips.is_core).sum() > 0
+        # polyfill ⊆ touching cells; core cells ⊆ polyfill
+        pf = set(polyfill(polys, res, grid)[0].tolist())
+        cells = set(chips.cell_id.tolist())
+        core = set(chips.cell_id[chips.is_core].tolist())
+        assert core <= pf <= cells
+
+    def test_chip_areas_sum_to_polygon(self, name, res, domain):
+        """Σ chip areas == polygon area (exact tessellation)."""
+        from mosaic_tpu.core.geometry.clip import (geometry_rings,
+                                                   ring_signed_area)
+        grid = get_index_system(name)
+        polys = self._poly(domain)
+        chips = tessellate(polys, res, grid, keep_core_geom=True)
+        total = 0.0
+        for i in range(len(chips.cell_id)):
+            rings = geometry_rings(chips.geoms, i)
+            total += sum(ring_signed_area(r) for r in rings)
+        want = sum(ring_signed_area(r)
+                   for r in geometry_rings(polys, 0))
+        assert total == pytest.approx(want, rel=1e-6)
+
+    def test_pip_join_parity(self, name, res, domain, rng):
+        import jax
+        import jax.numpy as jnp
+        from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                                  host_recheck, localize,
+                                                  make_pip_join_fn,
+                                                  pip_host_truth)
+        grid = get_index_system(name)
+        polys = self._poly(domain)
+        idx = build_pip_index(polys, res, grid)
+        fn = jax.jit(make_pip_join_fn(idx, grid))
+        x0, y0, x1, y1 = domain
+        pts = np.stack([rng.uniform(x0, x1, 3000),
+                        rng.uniform(y0, y1, 3000)], -1)
+        z, u = fn(jnp.asarray(localize(idx, pts)))
+        final = host_recheck(pts, np.asarray(z), np.asarray(u), polys)
+        truth = pip_host_truth(pts, polys)
+        assert np.array_equal(final, truth)
