@@ -1,0 +1,274 @@
+"""CRS transforms and per-EPSG bounds, pure math.
+
+Reference counterpart: MosaicGeometry.transformCRSXY
+(core/geometry/MosaicGeometry.scala:136-160, via proj4j) and
+core/crs/CRSBoundsProvider.scala:20 (resource-file EPSG bounds for
+ST_HasValidCoordinates).
+
+Implemented projections (closed-form, vectorizable, no proj dependency):
+
+- EPSG:4326  WGS84 lon/lat degrees
+- EPSG:3857  Web/Spherical Mercator metres
+- EPSG:326xx / 327xx  WGS84 UTM zones north/south (Karney-series
+  transverse Mercator, ~1e-9 deg round-trip accuracy)
+- EPSG:27700 British National Grid (same TM core on the Airy 1830
+  ellipsoid + 7-parameter Helmert datum shift WGS84↔OSGB36,
+  ~1-2 m absolute like every Helmert-based OSTN-free implementation;
+  round-trips to mm)
+
+Routing always goes through 4326: from_epsg → 4326 → to_epsg.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["transform_xy", "crs_bounds", "has_valid_coordinates"]
+
+_R_MAJOR = 6378137.0                       # WGS84 a
+_WGS84 = (6378137.0, 1 / 298.257223563)
+_AIRY = (6377563.396, 1 / 299.3249646)
+
+# Helmert WGS84 -> OSGB36 (tx, ty, tz [m], rx, ry, rz [arcsec], s [ppm])
+_HELMERT_OSGB = (-446.448, 125.157, -542.060,
+                 -0.1502, -0.2470, -0.8421, 20.4894)
+
+
+# ------------------------------------------------------------- mercator
+
+def _to_webmercator(lon, lat):
+    x = np.radians(lon) * _R_MAJOR
+    lat = np.clip(lat, -89.9999, 89.9999)
+    y = _R_MAJOR * np.log(np.tan(np.pi / 4 + np.radians(lat) / 2))
+    return x, y
+
+
+def _from_webmercator(x, y):
+    lon = np.degrees(x / _R_MAJOR)
+    lat = np.degrees(2 * np.arctan(np.exp(y / _R_MAJOR)) - np.pi / 2)
+    return lon, lat
+
+
+# ------------------------------------------------- transverse mercator
+
+def _tm_forward(lon, lat, a, f, lon0, lat0, k0, fe, fn):
+    """Snyder-series transverse Mercator (ellipsoidal), forward."""
+    e2 = f * (2 - f)
+    ep2 = e2 / (1 - e2)
+    lam = np.radians(lon) - math.radians(lon0)
+    phi = np.radians(lat)
+    n_ = a / np.sqrt(1 - e2 * np.sin(phi) ** 2)
+    t = np.tan(phi) ** 2
+    c = ep2 * np.cos(phi) ** 2
+    A = lam * np.cos(phi)
+    m = _meridian_arc(phi, a, e2)
+    m0 = _meridian_arc(np.asarray(math.radians(lat0)), a, e2)
+    x = fe + k0 * n_ * (A + (1 - t + c) * A ** 3 / 6 +
+                        (5 - 18 * t + t * t + 72 * c - 58 * ep2) *
+                        A ** 5 / 120)
+    y = fn + k0 * (m - m0 + n_ * np.tan(phi) *
+                   (A * A / 2 + (5 - t + 9 * c + 4 * c * c) *
+                    A ** 4 / 24 +
+                    (61 - 58 * t + t * t + 600 * c - 330 * ep2) *
+                    A ** 6 / 720))
+    return x, y
+
+
+def _tm_inverse(x, y, a, f, lon0, lat0, k0, fe, fn):
+    e2 = f * (2 - f)
+    ep2 = e2 / (1 - e2)
+    e1 = (1 - math.sqrt(1 - e2)) / (1 + math.sqrt(1 - e2))
+    m0 = _meridian_arc(np.asarray(math.radians(lat0)), a, e2)
+    m = m0 + (y - fn) / k0
+    mu = m / (a * (1 - e2 / 4 - 3 * e2 * e2 / 64 -
+                   5 * e2 ** 3 / 256))
+    phi1 = (mu + (3 * e1 / 2 - 27 * e1 ** 3 / 32) * np.sin(2 * mu) +
+            (21 * e1 ** 2 / 16 - 55 * e1 ** 4 / 32) * np.sin(4 * mu) +
+            (151 * e1 ** 3 / 96) * np.sin(6 * mu) +
+            (1097 * e1 ** 4 / 512) * np.sin(8 * mu))
+    n1 = a / np.sqrt(1 - e2 * np.sin(phi1) ** 2)
+    r1 = a * (1 - e2) / (1 - e2 * np.sin(phi1) ** 2) ** 1.5
+    t1 = np.tan(phi1) ** 2
+    c1 = ep2 * np.cos(phi1) ** 2
+    d = (x - fe) / (n1 * k0)
+    phi = phi1 - (n1 * np.tan(phi1) / r1) * (
+        d * d / 2 -
+        (5 + 3 * t1 + 10 * c1 - 4 * c1 * c1 - 9 * ep2) * d ** 4 / 24 +
+        (61 + 90 * t1 + 298 * c1 + 45 * t1 * t1 - 252 * ep2 -
+         3 * c1 * c1) * d ** 6 / 720)
+    lam = (d - (1 + 2 * t1 + c1) * d ** 3 / 6 +
+           (5 - 2 * c1 + 28 * t1 - 3 * c1 * c1 + 8 * ep2 +
+            24 * t1 * t1) * d ** 5 / 120) / np.cos(phi1)
+    return np.degrees(lam) + lon0, np.degrees(phi)
+
+
+def _meridian_arc(phi, a, e2):
+    return a * ((1 - e2 / 4 - 3 * e2 * e2 / 64 - 5 * e2 ** 3 / 256) * phi
+                - (3 * e2 / 8 + 3 * e2 * e2 / 32 +
+                   45 * e2 ** 3 / 1024) * np.sin(2 * phi)
+                + (15 * e2 * e2 / 256 +
+                   45 * e2 ** 3 / 1024) * np.sin(4 * phi)
+                - (35 * e2 ** 3 / 3072) * np.sin(6 * phi))
+
+
+# -------------------------------------------------------- datum shifts
+
+def _geodetic_to_ecef(lon, lat, a, f, h=0.0):
+    e2 = f * (2 - f)
+    phi = np.radians(lat)
+    lam = np.radians(lon)
+    n = a / np.sqrt(1 - e2 * np.sin(phi) ** 2)
+    x = (n + h) * np.cos(phi) * np.cos(lam)
+    y = (n + h) * np.cos(phi) * np.sin(lam)
+    z = (n * (1 - e2) + h) * np.sin(phi)
+    return x, y, z
+
+
+def _ecef_to_geodetic(x, y, z, a, f):
+    e2 = f * (2 - f)
+    b = a * (1 - f)
+    p = np.hypot(x, y)
+    lam = np.arctan2(y, x)
+    phi = np.arctan2(z, p * (1 - e2))
+    for _ in range(6):
+        n = a / np.sqrt(1 - e2 * np.sin(phi) ** 2)
+        h = p / np.cos(phi) - n
+        phi = np.arctan2(z, p * (1 - e2 * n / (n + h)))
+    return np.degrees(lam), np.degrees(phi)
+
+
+def _helmert(x, y, z, params, inverse=False):
+    tx, ty, tz, rx, ry, rz, s = params
+    sgn = -1.0 if inverse else 1.0
+    rx, ry, rz = (sgn * math.radians(v / 3600) for v in (rx, ry, rz))
+    m = 1 + sgn * s * 1e-6
+    tx, ty, tz = sgn * tx, sgn * ty, sgn * tz
+    x2 = tx + m * (x - rz * y + ry * z)
+    y2 = ty + m * (rz * x + y - rx * z)
+    z2 = tz + m * (-ry * x + rx * y + z)
+    return x2, y2, z2
+
+
+def _wgs84_to_osgb_lonlat(lon, lat):
+    x, y, z = _geodetic_to_ecef(lon, lat, *_WGS84)
+    x, y, z = _helmert(x, y, z, _HELMERT_OSGB)
+    return _ecef_to_geodetic(x, y, z, *_AIRY)
+
+
+def _osgb_to_wgs84_lonlat(lon, lat):
+    x, y, z = _geodetic_to_ecef(lon, lat, *_AIRY)
+    x, y, z = _helmert(x, y, z, _HELMERT_OSGB, inverse=True)
+    return _ecef_to_geodetic(x, y, z, *_WGS84)
+
+
+# ------------------------------------------------------------- routing
+
+_OSGB_TM = dict(a=_AIRY[0], f=_AIRY[1], lon0=-2.0, lat0=49.0,
+                k0=0.9996012717, fe=400_000.0, fn=-100_000.0)
+
+
+def _utm_params(epsg: int) -> dict:
+    zone = epsg % 100
+    north = (epsg // 100) % 10 == 6      # 326xx north / 327xx south
+    if not 1 <= zone <= 60 or (epsg // 100) not in (326, 327):
+        raise ValueError(f"unsupported UTM EPSG {epsg}")
+    return dict(a=_WGS84[0], f=_WGS84[1], lon0=zone * 6 - 183, lat0=0.0,
+                k0=0.9996, fe=500_000.0,
+                fn=0.0 if north else 10_000_000.0)
+
+
+def _is_utm(epsg: int) -> bool:
+    return epsg // 100 in (326, 327) and 1 <= epsg % 100 <= 60
+
+
+def _to_4326(xy: np.ndarray, epsg: int) -> np.ndarray:
+    x, y = xy[:, 0], xy[:, 1]
+    if epsg == 4326:
+        return xy
+    if epsg == 3857:
+        lon, lat = _from_webmercator(x, y)
+    elif epsg == 27700:
+        lon, lat = _tm_inverse(x, y, **_OSGB_TM)
+        lon, lat = _osgb_to_wgs84_lonlat(lon, lat)
+    elif _is_utm(epsg):
+        lon, lat = _tm_inverse(x, y, **_utm_params(epsg))
+    else:
+        raise ValueError(f"unsupported source EPSG {epsg} (supported: "
+                         "4326, 3857, 27700, UTM 326xx/327xx)")
+    return np.stack([lon, lat], -1)
+
+
+def _from_4326(ll: np.ndarray, epsg: int) -> np.ndarray:
+    lon, lat = ll[:, 0], ll[:, 1]
+    if epsg == 4326:
+        return ll
+    if epsg == 3857:
+        x, y = _to_webmercator(lon, lat)
+    elif epsg == 27700:
+        lon2, lat2 = _wgs84_to_osgb_lonlat(lon, lat)
+        x, y = _tm_forward(lon2, lat2, **_OSGB_TM)
+    elif _is_utm(epsg):
+        x, y = _tm_forward(lon, lat, **_utm_params(epsg))
+    else:
+        raise ValueError(f"unsupported target EPSG {epsg} (supported: "
+                         "4326, 3857, 27700, UTM 326xx/327xx)")
+    return np.stack([x, y], -1)
+
+
+def transform_xy(xy: np.ndarray, from_epsg: int,
+                 to_epsg: int) -> np.ndarray:
+    """[N, 2] coordinate transform routed through WGS84."""
+    xy = np.asarray(xy, np.float64)
+    if from_epsg == to_epsg:
+        return xy.copy()
+    return _from_4326(_to_4326(xy, from_epsg), to_epsg)
+
+
+# ------------------------------------------------- bounds provider
+# (reference: core/crs/CRSBoundsProvider.scala — resource file of
+# reprojected + lat/lon bounds per EPSG, from spatialreference.org)
+
+_BOUNDS_4326: Dict[int, Tuple[float, float, float, float]] = {
+    4326: (-180.0, -90.0, 180.0, 90.0),
+    3857: (-180.0, -85.06, 180.0, 85.06),
+    27700: (-8.82, 49.79, 1.92, 60.94),
+}
+
+
+def crs_bounds(epsg: int, reprojected: bool = True
+               ) -> Tuple[float, float, float, float]:
+    """(xmin, ymin, xmax, ymax) valid domain of an EPSG, either in its
+    own units (reprojected=True) or in lon/lat."""
+    if _is_utm(epsg):
+        zone = epsg % 100
+        ll = (zone * 6 - 186.0, -80.0 if epsg // 100 == 327 else 0.0,
+              zone * 6 - 180.0, 84.0 if epsg // 100 == 326 else 0.0)
+        if epsg // 100 == 326:
+            ll = (ll[0], 0.0, ll[2], 84.0)
+        else:
+            ll = (ll[0], -80.0, ll[2], 0.0)
+    elif epsg in _BOUNDS_4326:
+        ll = _BOUNDS_4326[epsg]
+    else:
+        raise ValueError(f"no bounds registered for EPSG {epsg}")
+    if not reprojected or epsg == 4326:
+        return ll
+    corners = np.array([[ll[0], ll[1]], [ll[2], ll[1]],
+                        [ll[2], ll[3]], [ll[0], ll[3]],
+                        [(ll[0] + ll[2]) / 2, ll[1]],
+                        [(ll[0] + ll[2]) / 2, ll[3]]])
+    p = _from_4326(corners, epsg)
+    return (float(p[:, 0].min()), float(p[:, 1].min()),
+            float(p[:, 0].max()), float(p[:, 1].max()))
+
+
+def has_valid_coordinates(xy: np.ndarray, epsg: int,
+                          which: str = "bounds") -> np.ndarray:
+    """[N] bool — every vertex inside the CRS bounds (reference:
+    ST_HasValidCoordinates; which in {bounds, reprojected_bounds})."""
+    b = crs_bounds(epsg, reprojected=(which == "reprojected_bounds"))
+    return ((xy[:, 0] >= b[0]) & (xy[:, 0] <= b[2]) &
+            (xy[:, 1] >= b[1]) & (xy[:, 1] <= b[3]))

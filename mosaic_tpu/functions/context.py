@@ -293,6 +293,142 @@ class MosaicContext(RasterFunctions):
         return _dc.replace(g, coords=c)
 
     # ------------------------------------------------------------------
+    # hard ops: buffer / simplify / hulls / validity / CRS / triangulate
+    # (reference: MosaicGeometry.scala:125-160 via JTS; proj4j for CRS)
+    # ------------------------------------------------------------------
+    def st_buffer(self, g: Geoms, radius,
+                  cap_style: str = "round") -> Geoms:
+        """reference: ST_Buffer (+ cap style variant)"""
+        from ..core.geometry.ops import buffer_geometry
+        return buffer_geometry(g, radius, cap_style=cap_style)
+
+    def st_buffer_cap_style(self, g: Geoms, radius,
+                            cap_style: str) -> Geoms:
+        return self.st_buffer(g, radius, cap_style=cap_style)
+
+    def st_bufferloop(self, g: Geoms, inner: float,
+                      outer: float) -> Geoms:
+        """Ring between two buffer radii (reference: ST_BufferLoop)."""
+        from ..core.geometry.clip import boolean_op
+        return boolean_op(self.st_buffer(g, outer),
+                          self.st_buffer(g, inner), "difference")
+
+    def st_simplify(self, g: Geoms, tolerance) -> Geoms:
+        """reference: ST_Simplify (Douglas-Peucker)"""
+        from ..core.geometry.ops import simplify_geometry
+        return simplify_geometry(g, tolerance)
+
+    def st_convexhull(self, g: Geoms) -> Geoms:
+        """reference: ST_ConvexHull"""
+        from ..core.geometry.ops import convex_hull_points
+        b = GeometryBuilder(srid=g.srid)
+        starts = g.vertex_starts()
+        for gi in range(len(g)):
+            pts = g.coords[starts[gi]:starts[gi + 1], :2]
+            hull = convex_hull_points(pts)
+            if len(hull) >= 3:
+                b.add_polygon(np.vstack([hull, hull[:1]]))
+            else:
+                b.add(GeometryType.POLYGON, [[np.zeros((0, 2))]])
+        return b.finish()
+
+    def st_concavehull(self, g: Geoms,
+                       length_ratio: float = 0.3) -> Geoms:
+        """reference: ST_ConcaveHull (JTS edge-length erosion)"""
+        from ..core.geometry.triangulate import concave_hull_points
+        b = GeometryBuilder(srid=g.srid)
+        starts = g.vertex_starts()
+        for gi in range(len(g)):
+            pts = g.coords[starts[gi]:starts[gi + 1], :2]
+            hull = concave_hull_points(pts, length_ratio)
+            if len(hull) >= 3:
+                b.add_polygon(np.vstack([hull, hull[:1]]))
+            else:
+                b.add(GeometryType.POLYGON, [[np.zeros((0, 2))]])
+        return b.finish()
+
+    def st_isvalid(self, g: Geoms) -> np.ndarray:
+        """reference: ST_IsValid"""
+        from ..core.geometry.clip import geometry_rings
+        from ..core.geometry.ops import is_valid_rings
+        out = np.zeros(len(g), bool)
+        for gi in range(len(g)):
+            t = g.geom_type(gi)
+            if t in (GeometryType.POLYGON, GeometryType.MULTIPOLYGON):
+                out[gi] = is_valid_rings(geometry_rings(g, gi))
+            else:
+                out[gi] = g.vertex_counts()[gi] > 0
+        return out
+
+    def st_transform(self, g: Geoms, to_epsg: int) -> Geoms:
+        """reference: ST_Transform (proj4j CRS transform)"""
+        import dataclasses as _dc
+        from ..core.geometry.crs import transform_xy
+        c = g.coords.copy()
+        c[:, :2] = transform_xy(c[:, :2], g.srid or 4326, to_epsg)
+        return _dc.replace(g, coords=c, srid=to_epsg)
+
+    def st_updatesrid(self, g: Geoms, from_epsg: int,
+                      to_epsg: int) -> Geoms:
+        """reference: ST_UpdateSRID — transform assuming from_epsg."""
+        import dataclasses as _dc
+        return self.st_transform(_dc.replace(g, srid=from_epsg), to_epsg)
+
+    def st_hasvalidcoordinates(self, g: Geoms, epsg: int,
+                               which: str = "bounds") -> np.ndarray:
+        """reference: ST_HasValidCoordinates + CRSBoundsProvider"""
+        from ..core.geometry.crs import has_valid_coordinates
+        ok = has_valid_coordinates(g.coords[:, :2], epsg, which)
+        starts = g.vertex_starts()
+        return np.asarray([bool(ok[starts[i]:starts[i + 1]].all())
+                           for i in range(len(g))])
+
+    def st_triangulate(self, g: Geoms,
+                       constraints: Optional[Geoms] = None) -> Geoms:
+        """TIN faces of each geometry's vertices (+ optional breakline
+        constraints) — reference: ST_Triangulate over the conforming
+        Delaunay builder."""
+        from ..core.geometry.triangulate import (conforming_delaunay,
+                                                 delaunay)
+        b = GeometryBuilder(srid=g.srid)
+        starts = g.vertex_starts()
+        segs = None
+        if constraints is not None and len(constraints):
+            cs = []
+            cstarts = constraints.vertex_starts()
+            for ci in range(len(constraints)):
+                pts = constraints.coords[cstarts[ci]:cstarts[ci + 1], :2]
+                for k in range(len(pts) - 1):
+                    cs.append((pts[k], pts[k + 1]))
+            segs = np.asarray(cs) if cs else None
+        for gi in range(len(g)):
+            pts = g.coords[starts[gi]:starts[gi + 1], :2]
+            verts, tri = (conforming_delaunay(pts, segs)
+                          if segs is not None else delaunay(pts))
+            b.add(GeometryType.MULTIPOLYGON,
+                  [[np.vstack([verts[t], verts[t[:1]]])] for t in tri]
+                  or [[np.zeros((0, 2))]])
+        return b.finish()
+
+    def st_interpolateelevation(self, mass_points: Geoms,
+                                query: Geoms) -> np.ndarray:
+        """Z at query points from the TIN of 3D mass points (reference:
+        ST_InterpolateElevation)."""
+        from ..core.geometry.triangulate import delaunay, interpolate_z
+        if mass_points.ndim < 3:
+            raise ValueError("mass points must carry z coordinates")
+        xy = mass_points.coords[:, :2]
+        z = mass_points.coords[:, 2]
+        verts, tri = delaunay(xy)
+        # map z onto deduped verts
+        zmap = np.empty(len(verts))
+        for i, v in enumerate(verts):
+            j = int(np.argmin(np.sum((xy - v) ** 2, axis=1)))
+            zmap[i] = z[j]
+        q = np.asarray(points_block(query, dtype=np.float64))
+        return interpolate_z(verts, zmap, tri, q)
+
+    # ------------------------------------------------------------------
     # overlay ops (general polygon boolean algebra)
     # (reference: MosaicGeometry.intersection/union/difference,
     #  core/geometry/MosaicGeometry.scala:125-160, via JTS overlay)

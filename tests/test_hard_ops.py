@@ -1,0 +1,243 @@
+"""Hard geometry ops: buffer, simplify, hulls, validity, CRS,
+triangulation (reference behaviors: ST_BufferBehaviors,
+ST_SimplifyBehaviors, ST_TransformBehaviors, ST_TriangulateBehaviors).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry.clip import (_pip_rings, geometry_rings,
+                                           ring_signed_area)
+from mosaic_tpu.core.geometry.crs import (crs_bounds, transform_xy,
+                                          has_valid_coordinates)
+from mosaic_tpu.core.geometry.ops import (convex_hull_points,
+                                          is_valid_rings, simplify_ring)
+from mosaic_tpu.core.geometry.triangulate import (concave_hull_points,
+                                                  conforming_delaunay,
+                                                  delaunay,
+                                                  interpolate_z)
+from mosaic_tpu.functions.context import MosaicContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MosaicContext.build("CUSTOM(0,16,0,16,2,1,1)")
+
+
+class TestBuffer:
+    def test_square_buffer_area(self, ctx):
+        g = ctx.st_geomfromwkt(["POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))"])
+        out = ctx.st_buffer(g, 1.0)
+        # area = 100 + perimeter*r + pi*r² (rounded corners)
+        want = 100 + 40 * 1.0 + np.pi
+        assert ctx.st_area(out)[0] == pytest.approx(want, rel=1e-2)
+
+    def test_negative_buffer(self, ctx):
+        g = ctx.st_geomfromwkt(["POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))"])
+        out = ctx.st_buffer(g, -1.0)
+        assert ctx.st_area(out)[0] == pytest.approx(64.0, rel=1e-2)
+
+    def test_point_buffer(self, ctx):
+        g = ctx.st_geomfromwkt(["POINT(3 3)"])
+        out = ctx.st_buffer(g, 2.0)
+        assert ctx.st_area(out)[0] == pytest.approx(np.pi * 4, rel=1e-2)
+
+    def test_line_buffer_cap_styles(self, ctx):
+        g = ctx.st_geomfromwkt(["LINESTRING(0 0, 10 0)"])
+        round_a = ctx.st_area(ctx.st_buffer(g, 1.0, "round"))[0]
+        flat_a = ctx.st_area(ctx.st_buffer(g, 1.0, "flat"))[0]
+        square_a = ctx.st_area(ctx.st_buffer(g, 1.0, "square"))[0]
+        assert flat_a == pytest.approx(20.0, rel=1e-6)
+        assert round_a == pytest.approx(20 + np.pi, rel=1e-2)
+        assert square_a == pytest.approx(24.0, rel=1e-2)
+
+    def test_buffer_contains_original(self, ctx, rng):
+        g = ctx.st_geomfromwkt(
+            ["POLYGON((1 1, 9 1, 9 5, 5 5, 5 9, 1 9, 1 1))"])
+        out = ctx.st_buffer(g, 0.5)
+        rings = geometry_rings(out, 0)
+        pts = rng.uniform(0, 10, (2000, 2))
+        orig = _pip_rings(pts, geometry_rings(g, 0))
+        buf = _pip_rings(pts, rings)
+        assert not np.any(orig & ~buf)
+
+    def test_bufferloop(self, ctx):
+        g = ctx.st_geomfromwkt(["POLYGON((2 2, 8 2, 8 8, 2 8, 2 2))"])
+        ring = ctx.st_bufferloop(g, 0.5, 1.0)
+        inner = ctx.st_area(ctx.st_buffer(g, 0.5))[0]
+        outer = ctx.st_area(ctx.st_buffer(g, 1.0))[0]
+        assert ctx.st_area(ring)[0] == pytest.approx(outer - inner,
+                                                     rel=1e-6)
+
+
+class TestSimplify:
+    def test_collinear_removed(self):
+        r = np.array([[0, 0], [1, 0], [2, 0], [3, 0], [3, 3], [0, 3]])
+        s = simplify_ring(r, 1e-9, closed=True)
+        assert len(s) == 4
+
+    def test_tolerance_monotone(self, ctx, rng):
+        th = np.linspace(0, 2 * np.pi, 100, endpoint=False)
+        ring = np.stack([5 + 3 * np.cos(th) + rng.normal(0, .05, 100),
+                         5 + 3 * np.sin(th) + rng.normal(0, .05, 100)],
+                        -1)
+        wkt = "POLYGON((" + ", ".join(
+            f"{x} {y}" for x, y in np.vstack([ring, ring[:1]])) + "))"
+        g = ctx.st_geomfromwkt([wkt])
+        n0 = ctx.st_numpoints(g)[0]
+        n1 = ctx.st_numpoints(ctx.st_simplify(g, 0.05))[0]
+        n2 = ctx.st_numpoints(ctx.st_simplify(g, 0.5))[0]
+        assert n2 < n1 < n0
+        a = ctx.st_area(ctx.st_simplify(g, 0.05))[0]
+        assert a == pytest.approx(np.pi * 9, rel=0.1)
+
+
+class TestHulls:
+    def test_convex_hull_square(self):
+        pts = np.vstack([np.random.default_rng(0).uniform(0, 1, (100, 2)),
+                         [[0, 0], [1, 0], [1, 1], [0, 1]]])
+        hull = convex_hull_points(pts)
+        assert ring_signed_area(hull) == pytest.approx(1.0, rel=1e-9)
+
+    def test_concave_hull_tighter_than_convex(self, rng):
+        # C-shaped point cloud
+        th = np.linspace(0.3, 2 * np.pi - 0.3, 200)
+        pts = np.stack([np.cos(th), np.sin(th)], -1) * \
+            rng.uniform(0.7, 1.0, (200, 1))
+        concave = concave_hull_points(pts, 0.2)
+        convex = convex_hull_points(pts)
+        assert abs(ring_signed_area(concave)) < \
+            abs(ring_signed_area(convex))
+
+    def test_st_convexhull(self, ctx):
+        g = ctx.st_geomfromwkt(["MULTIPOINT(0 0, 4 0, 4 4, 0 4, 2 2)"])
+        hull = ctx.st_convexhull(g)
+        assert ctx.st_area(hull)[0] == pytest.approx(16.0)
+
+
+class TestValidity:
+    def test_valid_polygon(self, ctx):
+        g = ctx.st_geomfromwkt(
+            ["POLYGON((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))"])
+        assert ctx.st_isvalid(g)[0]
+
+    def test_bowtie_invalid(self, ctx):
+        g = ctx.st_geomfromwkt(["POLYGON((0 0, 2 2, 2 0, 0 2, 0 0))"])
+        assert not ctx.st_isvalid(g)[0]
+
+    def test_hole_crossing_shell_invalid(self):
+        shell = np.array([[0, 0], [4, 0], [4, 4], [0, 4]], float)
+        hole = np.array([[3, 3], [6, 3], [6, 6], [3, 6]], float)[::-1]
+        assert not is_valid_rings([shell, hole])
+
+
+class TestCRS:
+    def test_osgb_known_point(self):
+        # London (-0.1276, 51.5072) -> BNG ~ (530042, 180358)
+        en = transform_xy(np.array([[-0.1276, 51.5072]]), 4326, 27700)
+        assert en[0, 0] == pytest.approx(530042, abs=60)
+        assert en[0, 1] == pytest.approx(180358, abs=60)
+
+    def test_roundtrips(self, rng):
+        ll = np.stack([rng.uniform(-5, 1, 50),
+                       rng.uniform(50, 58, 50)], -1)
+        for epsg in (3857, 27700, 32630):
+            out = transform_xy(transform_xy(ll, 4326, epsg), epsg, 4326)
+            assert np.abs(out - ll).max() < 1e-6
+
+    def test_webmercator_values(self):
+        out = transform_xy(np.array([[180.0, 0.0]]), 4326, 3857)
+        assert out[0, 0] == pytest.approx(20037508.34, rel=1e-6)
+        assert out[0, 1] == pytest.approx(0.0, abs=1e-6)
+
+    def test_st_transform_surface(self, ctx):
+        g = ctx.st_geomfromwkt(["POINT(-0.1276 51.5072)"])
+        out = ctx.st_transform(g, 27700)
+        assert out.srid == 27700
+        assert ctx.st_x(out)[0] == pytest.approx(530042, abs=60)
+
+    def test_bounds_and_validity(self, ctx):
+        b = crs_bounds(4326)
+        assert b == (-180.0, -90.0, 180.0, 90.0)
+        ok = has_valid_coordinates(
+            np.array([[0.0, 51.0], [3.0, 51.0]]), 27700)
+        assert ok.tolist() == [True, False]
+        g = ctx.st_geomfromwkt(["POINT(0 51)", "POINT(200 0)"])
+        assert ctx.st_hasvalidcoordinates(g, 4326).tolist() == \
+            [True, False]
+
+    def test_unsupported_epsg(self):
+        with pytest.raises(ValueError, match="EPSG"):
+            transform_xy(np.zeros((1, 2)), 4326, 2154)
+
+
+class TestTriangulate:
+    def test_delaunay_area_partition(self, rng):
+        pts = rng.uniform(0, 10, (60, 2))
+        verts, tri = delaunay(pts)
+        hull = convex_hull_points(pts)
+        total = sum(abs(ring_signed_area(verts[t])) for t in tri)
+        assert total == pytest.approx(abs(ring_signed_area(hull)),
+                                      rel=1e-9)
+
+    def test_delaunay_empty_circumcircles(self, rng):
+        from mosaic_tpu.core.geometry.triangulate import \
+            _circumcircle_contains
+        pts = rng.uniform(0, 1, (40, 2))
+        verts, tri = delaunay(pts)
+        for t in tri[:20]:
+            others = np.setdiff1d(np.arange(len(verts)), t)
+            for o in others[:10]:
+                assert not _circumcircle_contains(verts[t], verts[o])
+
+    def test_conforming_contains_constraint(self, rng):
+        pts = rng.uniform(0, 10, (40, 2))
+        seg = np.array([[[1.0, 1.0], [9.0, 9.0]]])
+        verts, tri = conforming_delaunay(pts, seg)
+        # every point of the constraint line lies on some edge
+        from mosaic_tpu.core.geometry.triangulate import _edges_of_tris
+        edges = _edges_of_tris(tri)
+        samples = np.linspace(0, 1, 20)[:, None] * (seg[0, 1] -
+                                                    seg[0, 0]) + seg[0, 0]
+        for s in samples:
+            on = False
+            for (i, j) in edges:
+                a, b = verts[i], verts[j]
+                d = b - a
+                ln2 = d @ d
+                if ln2 == 0:
+                    continue
+                t = np.clip(((s - a) @ d) / ln2, 0, 1)
+                if np.hypot(*(a + t * d - s)) < 1e-6:
+                    on = True
+                    break
+            assert on
+
+    def test_interpolate_plane(self, rng):
+        # z = 2x + 3y + 1 must be reproduced exactly by a TIN
+        xy = rng.uniform(0, 10, (50, 2))
+        z = 2 * xy[:, 0] + 3 * xy[:, 1] + 1
+        verts, tri = delaunay(xy)
+        zv = 2 * verts[:, 0] + 3 * verts[:, 1] + 1
+        q = rng.uniform(2, 8, (30, 2))
+        got = interpolate_z(verts, zv, tri, q)
+        want = 2 * q[:, 0] + 3 * q[:, 1] + 1
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+
+    def test_st_triangulate_surface(self, ctx):
+        g = ctx.st_geomfromwkt(["MULTIPOINT(0 0, 4 0, 4 4, 0 4, 2 2)"])
+        tin = ctx.st_triangulate(g)
+        assert ctx.st_area(tin)[0] == pytest.approx(16.0, rel=1e-9)
+
+    def test_st_interpolateelevation(self, ctx):
+        from mosaic_tpu.core.geometry.array import GeometryBuilder
+        b = GeometryBuilder(ndim=3)
+        pts = [(0, 0, 1.0), (10, 0, 1.0), (10, 10, 1.0), (0, 10, 1.0),
+               (5, 5, 11.0)]
+        from mosaic_tpu.core.geometry.array import GeometryType
+        for p in pts:
+            b.add(GeometryType.POINT, [[np.array(p)[None]]])
+        mass = b.finish()
+        q = ctx.st_point([5.0], [5.0])
+        z = ctx.st_interpolateelevation(mass, q)
+        assert z[0] == pytest.approx(11.0)
