@@ -74,9 +74,10 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from mosaic_tpu.bench.workloads import build_workload, nyc_points
-    from mosaic_tpu.parallel.pip_join import (build_pip_index,
-                                              host_recheck, localize,
-                                              make_pip_join_fn,
+    from mosaic_tpu.parallel.pip_join import (DensePIPIndex,
+                                              build_pip_index,
+                                              host_recheck, host_recheck_fn,
+                                              localize, make_pip_join_fn,
                                               pip_host_truth,
                                               zone_histogram)
 
@@ -85,49 +86,65 @@ def main():
     polys, grid, res = build_workload(n_side=16, grid_name="H3",
                                       zones="taxi")
     idx = build_pip_index(polys, res, grid)
-    edges_per_chip = (float(np.asarray(idx.chip_mask).sum())
-                      / max(idx.num_chips, 1))
-    log(f"tessellated {len(polys)} zones -> {len(idx.core_cells)} core + "
-        f"{idx.num_chips} border chips (max_dup={idx.max_dup}, "
-        f"{edges_per_chip:.1f} edges/chip) in {time.time()-t0:.1f}s")
+    dense = isinstance(idx, DensePIPIndex)
+    log(f"tessellated {len(polys)} zones -> "
+        f"{type(idx).__name__} ({idx.num_chips} border groups) "
+        f"in {time.time()-t0:.1f}s")
 
     join = make_pip_join_fn(idx, grid)
     n_zones = len(polys)
+    recheck = host_recheck_fn(idx) if dense else (
+        lambda p, z, u: host_recheck(p, z, u, polys))
 
     def step(points):
         zone, uncertain = join(points)
-        return zone, zone_histogram(zone, n_zones), jnp.sum(uncertain)
+        return zone, uncertain, zone_histogram(zone, n_zones)
 
     stepc = jax.jit(step)
     n = 1 << 22                      # 4M points per launch
     pts64 = nyc_points(n)
     pts = jnp.asarray(localize(idx, pts64))
     t0 = time.time()
-    zone, hist, unc = jax.block_until_ready(stepc(pts))
+    out = jax.block_until_ready(stepc(pts))
     log(f"compile+first step: {time.time()-t0:.1f}s on {platform}")
 
     # steady state: distinct device-resident batches per launch so no
-    # layer (XLA, runtime, tunnel) can replay a previous result
+    # layer (XLA, runtime, tunnel) can replay a previous result.
+    # End-to-end per batch = device join + flag transfer + f64 host
+    # recheck of flagged points (the exactness contract's full cost —
+    # round 2 reported device time only, VERDICT.md What's-weak #2).
     iters = 5
-    batches = [jax.device_put(jnp.asarray(
-        localize(idx, nyc_points(n, seed=100 + i))))
-               for i in range(iters)]
+    host_batches = [nyc_points(n, seed=100 + i) for i in range(iters)]
+    batches = [jax.device_put(jnp.asarray(localize(idx, hb)))
+               for hb in host_batches]
     jax.block_until_ready(batches)
-    times = []
+    dev_times, e2e_times, unc_total, matched = [], [], 0, 0
     for i in range(iters):
         t0 = time.time()
-        out = stepc(batches[i])
-        jax.block_until_ready(out)
-        times.append(time.time() - t0)
-    dt = float(np.median(times))
+        z, u, h = stepc(batches[i])
+        jax.block_until_ready((z, u, h))
+        t1 = time.time()
+        zh = np.asarray(z)
+        uh = np.asarray(u)
+        zh = recheck(host_batches[i], zh, uh)
+        t2 = time.time()
+        dev_times.append(t1 - t0)
+        e2e_times.append(t2 - t0)
+        unc_total += int(uh.sum())
+        matched += int(np.asarray(h).sum())
+    dt_dev = float(np.median(dev_times))
+    dt = float(np.median(e2e_times))
     pps = n / dt
-    log(f"{n} pts in {dt*1e3:.1f} ms -> {pps/1e6:.2f}M pts/s; "
-        f"uncertain={int(unc)} ({int(unc)/n:.2e})")
+    unc_frac = unc_total / (iters * n)
+    log(f"{n} pts: device {dt_dev*1e3:.1f} ms, end-to-end (incl f64 "
+        f"recheck) {dt*1e3:.1f} ms -> {pps/1e6:.2f}M pts/s; "
+        f"uncertain_frac={unc_frac:.2e}; matched "
+        f"{matched/(iters*n):.3f} of points (zone histogram)")
 
     # exactness: f32 device result + f64 host recheck vs full host f64 PIP
     m = 50_000
     zs, us = jax.jit(join)(jnp.asarray(localize(idx, pts64[:m])))
-    zs = host_recheck(pts64[:m], np.asarray(zs), np.asarray(us), polys)
+    zs = recheck(pts64[:m], np.asarray(zs), np.asarray(us))
     truth = pip_host_truth(pts64[:m], polys)
     mismatch = int(np.sum(zs != truth))
     log(f"parity check: {mismatch}/{m} mismatches vs host float64 path")
@@ -141,10 +158,10 @@ def main():
         "platform": platform,
         "parity_mismatches": mismatch,
         "zones": n_zones,
-        "border_chips": idx.num_chips,
-        "max_dup": idx.max_dup,
-        "edges_per_chip": round(edges_per_chip, 1),
-        "uncertain_frac": round(int(unc) / n, 8),
+        "index": type(idx).__name__,
+        "device_ms": round(dt_dev * 1e3, 1),
+        "end_to_end_ms": round(dt * 1e3, 1),
+        "uncertain_frac": round(unc_frac, 8),
     }))
 
 

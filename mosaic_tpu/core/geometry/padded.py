@@ -69,6 +69,12 @@ def _pad_cap(n: int, minimum: int = 8) -> int:
     return cap
 
 
+def build_edges_np(arr: GeometryArray, capacity: Optional[int] = None,
+                   normalize: bool = True):
+    """Numpy-f64 core of build_edges: (A, B, M) padded edge blocks."""
+    return _build_edges_np(arr, capacity, normalize)
+
+
 def build_edges(arr: GeometryArray, capacity: Optional[int] = None,
                 dtype=jnp.float32, normalize: bool = True) -> EdgeBlocks:
     """Build padded edge blocks from a GeometryArray (host-side).
@@ -79,6 +85,13 @@ def build_edges(arr: GeometryArray, capacity: Optional[int] = None,
     Points and linestrings yield their segments (open; no closing edge),
     letting length/distance kernels reuse the same layout.
     """
+    A, B, M = _build_edges_np(arr, capacity, normalize)
+    return EdgeBlocks(jnp.asarray(A, dtype=dtype),
+                      jnp.asarray(B, dtype=dtype), jnp.asarray(M))
+
+
+def _build_edges_np(arr: GeometryArray, capacity: Optional[int],
+                    normalize: bool):
     g = len(arr)
     ring_part = arr.ring_part_ids()
     part_geom = arr.part_geom_ids()
@@ -130,8 +143,7 @@ def build_edges(arr: GeometryArray, capacity: Optional[int] = None,
             B[i, k:k + n] = b
             M[i, k:k + n] = True
             k += n
-    return EdgeBlocks(jnp.asarray(A, dtype=dtype), jnp.asarray(B, dtype=dtype),
-                      jnp.asarray(M))
+    return A, B, M
 
 
 def points_block(arr: GeometryArray, dtype=jnp.float32) -> jnp.ndarray:

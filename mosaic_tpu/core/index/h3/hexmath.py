@@ -235,3 +235,59 @@ def hex2d_to_geo(hex2d: np.ndarray, face: np.ndarray,
 
 def is_class_iii(res: int) -> bool:
     return res % 2 == 1
+
+
+# ------------------------------------------- stable vector-form projection
+
+def face_tangent_bases() -> tuple:
+    """Per-face orthonormal tangent bases (E1, E2), each [20, 3] f64.
+
+    E1 points along the Class II i-axis (bearing FACE_AXES_AZ_I from the
+    face center), E2 completes the frame so that the planar coords of a
+    point P are exactly
+
+        x = (P · E1) / (P · F),   y = (P · E2) / (P · F)
+
+    in gnomonic units (times the resolution scale) — algebraically equal
+    to the polar form in geo_to_hex2d but WELL-CONDITIONED: the polar
+    route loses ~1e-7 relative near face centers through arccos (the
+    arccos derivative blows up at 1), which is why the f32 device kernel
+    needed a 3-meter uncertainty band before this form existed."""
+    f = face_center_xyz()                              # [20, 3]
+    lat = FACE_CENTER_GEO[:, 0]
+    north = np.array([0.0, 0.0, 1.0])
+    n_t = north[None, :] - np.sin(lat)[:, None] * f    # north tangent
+    n_t /= np.linalg.norm(n_t, axis=-1, keepdims=True)
+    e_t = np.cross(np.broadcast_to(north, f.shape), f)  # east tangent
+    e_t /= np.linalg.norm(e_t, axis=-1, keepdims=True)
+    az = FACE_AXES_AZ_I[:, None]
+    e1 = np.cos(az) * n_t + np.sin(az) * e_t
+    e2 = np.sin(az) * n_t - np.cos(az) * e_t
+    return e1, e2
+
+
+def scaled_bases(res: int) -> tuple:
+    """(E1s, E2s) with the resolution scale and Class III rotation folded
+    in, so hex2d = ((P·E1s)/(P·F), (P·E2s)/(P·F)) directly."""
+    e1, e2 = face_tangent_bases()
+    if is_class_iii(res):
+        c, s = np.cos(M_AP7_ROT_RADS), np.sin(M_AP7_ROT_RADS)
+        e1, e2 = c * e1 + s * e2, -s * e1 + c * e2
+    scale = M_SQRT7 ** res / RES0_U_GNOMONIC
+    return e1 * scale, e2 * scale
+
+
+def project_lattice(latlng: np.ndarray, res: int, face: np.ndarray = None):
+    """Stable equivalent of geo_to_hex2d: (face, hex2d) via tangent-basis
+    dot products instead of the arccos/atan2 polar chain.  Same frame,
+    same values (validated to ~1e-12 relative in tests)."""
+    latlng = np.asarray(latlng, np.float64)
+    xyz = geo_to_xyz(latlng)
+    if face is None:
+        face = nearest_face(xyz)
+    e1, e2 = scaled_bases(res)
+    f = face_center_xyz()[face]
+    u = np.sum(xyz * f, axis=-1)
+    x = np.sum(xyz * e1[face], axis=-1) / u
+    y = np.sum(xyz * e2[face], axis=-1) / u
+    return face, np.stack([x, y], axis=-1)

@@ -1,0 +1,183 @@
+"""Double-single ("df") arithmetic: ~46-bit precision from f32 pairs.
+
+TPUs have no fast float64 (the VPU/MXU are f32/bf16 engines), but the
+grid kernels need better-than-f32 precision in a few places — the H3
+gnomonic projection (ops/../index/h3/jaxkernel.py) must place a point on
+a hex lattice whose extent is ~6e5 cell widths at res 15, and the PIP
+join's edge-crossing test must be exact relative to the f32-quantized
+chip representation.  The reference gets this for free from JVM/JNI
+float64 (H3IndexSystem.scala:168 -> native h3); here the classic
+Dekker/Knuth error-free transformations provide it as plain f32 tensor
+ops that XLA fuses like any other elementwise work (~5-17 flops per op).
+
+A df value is a pair (hi, lo) with hi = fl(hi + lo) and |lo| <= ulp(hi)/2,
+representing hi + lo exactly.  All ops assume round-to-nearest f32 and no
+reassociation — XLA preserves both (it does not apply unsafe FP
+optimizations to these ops).
+
+References: Dekker (1971), "A floating-point technique for extending the
+available precision"; Hida/Li/Bailey's ddfun patterns.  The constants use
+the f32 Veltkamp split factor 2^12 + 1.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SPLIT = np.float32(4097.0)          # 2^12 + 1 (f32 has 24-bit mantissa)
+
+
+def _ob(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin a rounded intermediate.
+
+    XLA CPU evaluates f32 chains with excess precision by default
+    (xla_allow_excess_precision), which makes Dekker error terms vanish —
+    (a - (s - bb)) is only the rounding error if s was actually rounded
+    to f32.  An optimization_barrier forces the materialization without
+    blocking unrelated fusion.  Measured: without it, two_sum's error
+    term collapses to 0 on XLA:CPU and df degrades to plain f32."""
+    return jax.lax.optimization_barrier(x)
+
+
+class DF(NamedTuple):
+    """A double-single value hi + lo (both f32 tensors)."""
+
+    hi: jnp.ndarray
+    lo: jnp.ndarray
+
+    def to_f32(self) -> jnp.ndarray:
+        return self.hi
+
+    def neg(self) -> "DF":
+        return DF(-self.hi, -self.lo)
+
+
+def df_const(x: Union[float, np.ndarray]) -> DF:
+    """Split host f64 value(s) into an exact df pair (trace-time)."""
+    x = np.asarray(x, np.float64)
+    hi = x.astype(np.float32)
+    lo = (x - hi.astype(np.float64)).astype(np.float32)
+    return DF(jnp.asarray(hi), jnp.asarray(lo))
+
+
+def df_from_f32(x: jnp.ndarray) -> DF:
+    return DF(x, jnp.zeros_like(x))
+
+
+def two_sum(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray,
+                                                     jnp.ndarray]:
+    """s + err == a + b exactly (Knuth; no magnitude assumption)."""
+    s = _ob(a + b)
+    bb = _ob(s - a)
+    err = (a - _ob(s - bb)) + (b - bb)
+    return s, err
+
+
+def fast_two_sum(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray,
+                                                          jnp.ndarray]:
+    """s + err == a + b exactly, REQUIRES |a| >= |b| (Dekker)."""
+    s = _ob(a + b)
+    err = b - _ob(s - a)
+    return s, err
+
+
+def two_prod(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray,
+                                                      jnp.ndarray]:
+    """p + err == a * b exactly (Veltkamp split; no fma dependence)."""
+    p = _ob(a * b)
+    ca = _ob(_SPLIT * a)
+    ahi = _ob(ca - _ob(ca - a))
+    alo = a - ahi
+    cb = _ob(_SPLIT * b)
+    bhi = _ob(cb - _ob(cb - b))
+    blo = b - bhi
+    err = ((_ob(ahi * bhi) - p) + _ob(ahi * blo) + _ob(alo * bhi)) + \
+        alo * blo
+    return p, err
+
+
+def df_add(x: DF, y: DF) -> DF:
+    """df + df (~11 flops, error <= 4 ulp²)."""
+    s, e = two_sum(x.hi, y.hi)
+    e = e + (x.lo + y.lo)
+    hi, lo = fast_two_sum(s, e)
+    return DF(hi, lo)
+
+
+def df_sub(x: DF, y: DF) -> DF:
+    return df_add(x, y.neg())
+
+
+def df_mul(x: DF, y: DF) -> DF:
+    """df * df (~20 flops)."""
+    p, e = two_prod(x.hi, y.hi)
+    e = e + (x.hi * y.lo + x.lo * y.hi)
+    hi, lo = fast_two_sum(p, e)
+    return DF(hi, lo)
+
+
+def df_mul_f32(x: DF, c: jnp.ndarray) -> DF:
+    p, e = two_prod(x.hi, c)
+    e = e + x.lo * c
+    hi, lo = fast_two_sum(p, e)
+    return DF(hi, lo)
+
+
+def df_div(x: DF, y: DF) -> DF:
+    """df / df via one Newton-corrected quotient."""
+    q1 = x.hi / y.hi
+    r = df_sub(x, df_mul_f32(y, q1))
+    q2 = (r.hi + r.lo) / y.hi
+    hi, lo = fast_two_sum(q1, q2)
+    return DF(hi, lo)
+
+
+def df_dot3(ax: DF, ay: DF, az: DF, bx: DF, by: DF, bz: DF) -> DF:
+    """ax*bx + ay*by + az*bz in df."""
+    return df_add(df_add(df_mul(ax, bx), df_mul(ay, by)), df_mul(az, bz))
+
+
+def df_round(x: DF) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(nearest integer as f32, signed residual x - round(x) as f32).
+
+    hi - round(hi) is exact (same-binade subtraction), so the residual
+    carries the full df precision collapsed to f32 — valid while the
+    residual magnitude stays well above ulp(hi), which the caller's
+    error budget guarantees."""
+    r = jnp.round(x.hi)
+    frac = (x.hi - r) + x.lo
+    # df rounding can land on the far side of a half-integer boundary
+    adj = jnp.where(frac > 0.5, 1.0, 0.0) - jnp.where(frac < -0.5, 1.0,
+                                                      0.0)
+    r = r + adj
+    frac = frac - adj
+    return r, frac
+
+
+def df_poly_sin(d: DF) -> DF:
+    """sin(d) for |d| <= 0.04 rad by Taylor series in df.
+
+    Error < d^7/5040 ~ 3e-14 at the bound — below df resolution.  The
+    H3 kernel guarantees the bound by limiting the localized window
+    (jaxkernel.MAX_LOCAL_DEG)."""
+    d2 = df_mul(d, d)
+    # d * (1 - d2/6 * (1 - d2/20))
+    t = df_sub(df_const(1.0), df_mul_f32(d2, np.float32(1.0 / 20.0)))
+    t = df_sub(df_const(1.0), df_mul(df_mul_f32(d2, np.float32(1.0 / 6.0)),
+                                     t))
+    return df_mul(d, t)
+
+
+def df_poly_cos(d: DF) -> DF:
+    """cos(d) for |d| <= 0.04 rad by Taylor series in df (err < 1e-15)."""
+    d2 = df_mul(d, d)
+    # 1 - d2/2 * (1 - d2/12 * (1 - d2/30))
+    t = df_sub(df_const(1.0), df_mul_f32(d2, np.float32(1.0 / 30.0)))
+    t = df_sub(df_const(1.0), df_mul(df_mul_f32(d2, np.float32(1.0 / 12.0)),
+                                     t))
+    t = df_sub(df_const(1.0), df_mul(df_mul_f32(d2, np.float32(0.5)), t))
+    return t

@@ -42,6 +42,21 @@ from ..core.tessellate import tessellate
 from ..ops.lookup import lookup
 from ..types import ChipSet
 
+#: f32 hazard band (degrees) around chip edges for the crossing-parity
+#: test: covers the f32 representation of points and chip vertices
+#: (~1.5e-8 deg at city magnitudes) and the f32 edge-intersection
+#: arithmetic (~1e-7 deg), with ~8x safety.
+EPS_EDGE_DEG = 1e-6
+
+
+def _workload_origin(polys: GeometryArray) -> np.ndarray:
+    """Shared local-frame origin of a polygon batch: round(mean bbox).
+    Both index types use this, so localize() inputs are interchangeable
+    between them for the same polygons."""
+    bb = polys.bboxes()
+    return np.round(np.array(
+        [np.nanmean(bb[:, [0, 2]]), np.nanmean(bb[:, [1, 3]])]), 1)
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
@@ -91,17 +106,25 @@ class PIPIndex:
 
 def build_pip_index(polys: GeometryArray, res: int, grid: IndexSystem,
                     chips: Optional[ChipSet] = None,
-                    dtype=jnp.float32) -> PIPIndex:
+                    dtype=jnp.float32, dense: str = "auto"):
     """Tessellate polygons and lay the chips out for device lookup.
+
+    Returns a DensePIPIndex (one-gather lattice-window fast path) when
+    the workload allows it, else the grid-agnostic sorted-table
+    PIPIndex.  ``dense``: "auto" | "never" | "require".
 
     Float32 cell-assignment hazards need no special index structure: the
     device quantizer reports a boundary margin, and low-margin points are
     flagged for the float64 host recheck (see make_pip_join_fn)."""
     if chips is None:
         chips = tessellate(polys, res, grid, keep_core_geom=False)
-    bb = polys.bboxes()
-    origin = np.round(np.array(
-        [np.nanmean(bb[:, [0, 2]]), np.nanmean(bb[:, [1, 3]])]), 1)
+    if dense != "never":
+        d = build_dense_pip_index(polys, res, grid, chips=chips)
+        if d is not None:
+            return d
+        if dense == "require":
+            raise ValueError("workload does not fit the dense fast path")
+    origin = _workload_origin(polys)
     core = chips.is_core
     core_cells = chips.cell_id[core]
     core_zone = chips.geom_id[core]
@@ -209,10 +232,11 @@ def localize(idx: PIPIndex, points64: np.ndarray) -> np.ndarray:
                       np.float32)
 
 
-def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 1e-5,
-                     margin_eps: float = 3e-5):
+def make_pip_join_fn(idx, grid: IndexSystem, eps: Optional[float] = None,
+                     margin_eps: Optional[float] = None):
     """Close the index over a jittable ``local_points -> (zone,
     uncertain)``; inputs come from ``localize`` (local-frame float32).
+    Dense indexes dispatch to make_dense_pip_join_fn.
 
     Exactness contract: every float32 hazard raises ``uncertain``, and
     host_recheck resolves those in float64 — (a) points within ``eps`` of
@@ -221,6 +245,14 @@ def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 1e-5,
     differ from the float64 path: local→absolute rounding ~4e-6° plus
     f32 projection error), (c) points near the grid's domain edge.
     Out-of-domain points are forced to zone −1."""
+    if isinstance(idx, DensePIPIndex):
+        return make_dense_pip_join_fn(
+            idx, eps=EPS_EDGE_DEG if eps is None else eps,
+            margin_eps_deg=margin_eps)
+    # sorted-path defaults (wider: its f32 absolute-coordinate cell
+    # assignment carries more error than the dense path's projection)
+    eps = 1e-5 if eps is None else eps
+    margin_eps = 3e-5 if margin_eps is None else margin_eps
 
     def fn(points: jnp.ndarray):
         absolute = points + idx.origin.astype(points.dtype)
@@ -245,8 +277,9 @@ def make_pip_join_fn(idx: PIPIndex, grid: IndexSystem, eps: float = 1e-5,
 
 # ----------------------------------------------------------- sharded path
 
-def make_sharded_pip_join(idx: PIPIndex, grid: IndexSystem, mesh,
-                          eps: float = 1e-5, margin_eps: float = 3e-5,
+def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
+                          eps: Optional[float] = None,
+                          margin_eps: Optional[float] = None,
                           axis: str = "data"):
     """The multi-chip join: points shard over ``axis``, the index
     replicates (the reference's broadcast-join regime, SURVEY.md P2).
@@ -277,6 +310,376 @@ def zone_histogram(zone: jnp.ndarray, num_zones: int) -> jnp.ndarray:
     zone = jnp.where(zone < 0, jnp.int32(num_zones), zone)
     return jnp.zeros(num_zones, jnp.int32).at[zone].add(
         1, mode="drop", indices_are_sorted=False)
+
+
+# --------------------------------------------------- dense lattice index
+#
+# The sorted-table path above is grid-agnostic but pays ~29 serial
+# binary-search gathers per point; measured on TPU v5e that was 56% of
+# the whole join (scratch: 1.9 s of a 3.4 s step at 4M points — TPU
+# gathers cost ~16-30 ns per row regardless of row width).  For H3
+# workloads that fit one icosahedron face (any city/metro/state-scale
+# join), the H3 kernel's intermediate (face, a, b) lattice coords index
+# a dense window table directly: ONE int32 gather replaces both binary
+# searches, and all chips of a cell are packed into ONE pool row so the
+# edge test is ONE more gather.  Design rule: one gather per point per
+# logical step.
+
+CORE_FLAG = np.int32(1) << 30
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DensePIPIndex:
+    """Device-resident dense-window tessellation index (H3, one face).
+
+    entry  [W*H] i32   per lattice cell: -1 empty; CORE_FLAG|zone core;
+                       else group index into pool
+    pool   [G, E, 5]   merged chip edges per border cell, local-frame
+                       f32: ax, ay, bx, by, zslot (-1 pad; pad coords
+                       at +1e9 so they never straddle/flag)
+    gzones [G, Z] i32  distinct zone ids per group (-1 pad)
+    origin [2] f64     local-frame origin (lon, lat)
+    static: face0, a0, b0, W, H, res, err_lattice (margin threshold),
+            n_zones
+    host-side aux (not traced): recheck CSR in f64 (see host_recheck_fn)
+    """
+
+    entry: jnp.ndarray
+    pool: jnp.ndarray
+    gzones: jnp.ndarray
+    origin: np.ndarray
+    face0: int
+    a0: int
+    b0: int
+    W: int
+    H: int
+    res: int
+    err_lattice: float
+    n_zones: int
+    #: max |local degree| over window cells (+ slack); join queries
+    #: beyond this are out-of-domain by construction
+    ext_deg: float = 2.0
+    aux: Optional[dict] = None
+
+    def tree_flatten(self):
+        return ((self.entry, self.pool, self.gzones),
+                (self.origin.tobytes(), self.face0, self.a0, self.b0,
+                 self.W, self.H, self.res, self.err_lattice,
+                 self.n_zones, self.ext_deg))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        origin = np.frombuffer(aux[0], np.float64)
+        return cls(*children, origin, *aux[1:])
+
+    @property
+    def num_chips(self) -> int:
+        return int(self.pool.shape[0])
+
+
+def _host_lattice(grid, pts_deg: np.ndarray, res: int):
+    """f64 (face, a, b) of absolute lon/lat degree points (host truth)."""
+    from ..core.index.h3 import hexmath as hm
+    latlng = np.radians(np.asarray(pts_deg, np.float64)[:, ::-1])
+    face, hex2d = hm.project_lattice(latlng, res)
+    ijk = hm.hex2d_to_ijk(hex2d)
+    return face, ijk[:, 0] - ijk[:, 2], ijk[:, 1] - ijk[:, 2]
+
+
+def build_dense_pip_index(polys: GeometryArray, res: int, grid,
+                          chips: Optional[ChipSet] = None,
+                          precision: str = "auto"
+                          ) -> Optional[DensePIPIndex]:
+    """Build the dense-window index, or None when the workload doesn't
+    fit the fast path (non-H3 grid, cells spanning icosahedron faces,
+    window larger than the df Taylor bound, or overlapping polygons
+    putting one cell in both core and border sets — the sorted-table
+    path handles those)."""
+    from ..core.geometry.padded import build_edges_np
+    from ..core.index.h3.jaxkernel import (MAX_LOCAL_DEG, err_lattice_bound,
+                                           pick_precision)
+    from ..core.index.h3.system import H3IndexSystem
+
+    if not isinstance(grid, H3IndexSystem):
+        return None
+    if chips is None:
+        chips = tessellate(polys, res, grid, keep_core_geom=False)
+    if len(chips) == 0:
+        return None
+
+    cells = np.unique(chips.cell_id)
+    centers = grid.cell_center(cells)                    # [C, 2] deg
+    origin = _workload_origin(polys)
+    _, circ = grid._cell_metrics_deg(res)                # max circumradius
+    # 2x: circumradius is angular degrees; lon extent is circ/cos(lat)
+    ext = float(max(np.max(np.abs(centers[:, 0] - origin[0])),
+                    np.max(np.abs(centers[:, 1] - origin[1])))) + 2 * circ
+    if ext > MAX_LOCAL_DEG - 0.1:
+        return None
+    face_c, a_c, b_c = _host_lattice(grid, centers, res)
+    if len(np.unique(face_c)) != 1:
+        return None
+    # face-edge safety: every window cell must be interior enough that
+    # no point of it can argmax to another face (facegap ≈ angular
+    # distance to the face boundary; 0.02 ≈ 1.1 degrees of arc)
+    from ..core.index.h3.hexmath import geo_to_xyz, face_center_xyz
+    xyz = geo_to_xyz(np.radians(centers[:, ::-1]))
+    dots = xyz @ face_center_xyz().T
+    srt = np.sort(dots, axis=1)
+    if np.min(srt[:, -1] - srt[:, -2]) < 0.02:
+        return None
+
+    core = chips.is_core
+    core_cells = chips.cell_id[core]
+    if len(np.intersect1d(core_cells, chips.cell_id[~core])):
+        return None                                      # overlap regime
+    if len(np.unique(core_cells)) != len(core_cells):
+        return None
+
+    face0 = int(face_c[0])
+    a0, b0 = int(a_c.min()) - 1, int(b_c.min()) - 1
+    W = int(a_c.max()) - a0 + 2
+    H = int(b_c.max()) - b0 + 2
+    if W * H > 64_000_000:
+        return None
+
+    lat_of = {int(c): (int(a), int(b))
+              for c, a, b in zip(cells, a_c, b_c)}
+
+    entry = np.full(W * H, -1, np.int32)
+
+    def lin(cell):
+        a, b = lat_of[int(cell)]
+        return (a - a0) * H + (b - b0)
+
+    for c, z in zip(core_cells, chips.geom_id[core]):
+        entry[lin(c)] = np.int32(z) | CORE_FLAG
+
+    # ---- border groups: all chips of a cell merged into one edge soup
+    b_cells = chips.cell_id[~core]
+    b_zone = chips.geom_id[~core].astype(np.int32)
+    border_idx = np.nonzero(~core)[0]
+    order = np.argsort(b_cells, kind="stable")
+    b_cells, b_zone = b_cells[order], b_zone[order]
+    chip_geoms = chips.geoms.take(border_idx[order])
+    A, B, M = build_edges_np(chip_geoms)                 # [Bc, cap, 2] f64
+    cnt = M.sum(axis=1)
+
+    ucells, ustart = np.unique(b_cells, return_index=True)
+    G = len(ucells)
+    gidx = np.searchsorted(ucells, b_cells)              # chip -> group
+    gedges = np.bincount(gidx, weights=cnt).astype(np.int64)
+    E = 8
+    while E < gedges.max():
+        E *= 2
+    if E > 512:
+        return None                                      # pathological cell
+
+    # distinct zones per group, first-appearance order; per-chip zslot
+    Z = 1
+    gzone_lists: list = [[] for _ in range(G)]
+    zslot_chip = np.zeros(len(b_cells), np.int32)
+    for i in range(len(b_cells)):
+        zl = gzone_lists[gidx[i]]
+        z = int(b_zone[i])
+        if z not in zl:
+            zl.append(z)
+        zslot_chip[i] = zl.index(z)
+    Z = max(1, max(len(zl) for zl in gzone_lists))
+    gzones = np.full((G, Z), -1, np.int32)
+    for g, zl in enumerate(gzone_lists):
+        gzones[g, :len(zl)] = zl
+
+    for g, c in enumerate(ucells):
+        entry[lin(c)] = np.int32(g)
+
+    # flatten valid edges in (group, chip, edge) order — already sorted
+    flat_a = A[M]                                        # [Etot, 2] f64
+    flat_b = B[M]
+    edge_chip = np.repeat(np.arange(len(b_cells)), cnt.astype(np.int64))
+    edge_group = gidx[edge_chip]
+    edge_zslot = zslot_chip[edge_chip]
+    gstart = np.zeros(G + 1, np.int64)
+    np.cumsum(gedges, out=gstart[1:])
+    pos = np.arange(len(flat_a)) - gstart[edge_group]
+
+    pool = np.full((max(G, 1), E, 5), 1e9, np.float32)
+    pool[..., 4] = -1.0
+    loc_a = flat_a - origin[None]
+    loc_b = flat_b - origin[None]
+    pool[edge_group, pos, 0] = loc_a[:, 0].astype(np.float32)
+    pool[edge_group, pos, 1] = loc_a[:, 1].astype(np.float32)
+    pool[edge_group, pos, 2] = loc_b[:, 0].astype(np.float32)
+    pool[edge_group, pos, 3] = loc_b[:, 1].astype(np.float32)
+    pool[edge_group, pos, 4] = edge_zslot.astype(np.float32)
+
+    prec = pick_precision(precision)
+    ext_deg = float(ext) + 0.1
+    err = err_lattice_bound(res, prec, ext_deg, localized=True)
+    aux = {
+        "flat_a": flat_a, "flat_b": flat_b,
+        "edge_zslot": edge_zslot.astype(np.int64),
+        "gstart": gstart, "gzones64": gzones.astype(np.int64),
+        "grid": grid, "polys": polys,
+    }
+    return DensePIPIndex(
+        entry=jnp.asarray(entry), pool=jnp.asarray(pool),
+        gzones=jnp.asarray(gzones), origin=origin, face0=face0,
+        a0=a0, b0=b0, W=W, H=H, res=res, err_lattice=float(err),
+        n_zones=len(polys), ext_deg=ext_deg, aux=aux)
+
+
+def make_dense_pip_join_fn(idx: DensePIPIndex, eps: float = EPS_EDGE_DEG,
+                           precision: str = "auto",
+                           margin_eps_deg: Optional[float] = None):
+    """Jittable ``local_points -> (zone, uncertain)`` on the dense index.
+
+    Exactness contract (same as the sorted path): every f32 hazard
+    raises ``uncertain`` — (a) hex-boundary margin below the validated
+    projection error bound (cell assignment could differ from f64),
+    (b) nearest-face ambiguity, (c) edge-crossing tests within ``eps``
+    of flipping (horizontal crossing distance or ray-through-vertex).
+    Points beyond the window's local extent are out-of-domain by
+    construction: zone -1, certain (their projection may even be outside
+    the df Taylor validity radius, so it must not be consulted).
+    host_recheck_fn resolves flagged points in f64."""
+    from ..core.index.h3.jaxkernel import (FACEGAP_EPS, err_lattice_bound,
+                                           pick_precision,
+                                           project_lattice_jax)
+    Z = int(idx.gzones.shape[1])
+    # margin threshold must match the arithmetic that actually runs —
+    # idx.err_lattice was derived at build time, possibly on another
+    # backend/precision; recompute for the resolved path and take the
+    # wider of the two
+    err_lat = max(idx.err_lattice, err_lattice_bound(
+        idx.res, pick_precision(precision), idx.ext_deg, localized=True))
+    if margin_eps_deg is not None:
+        # honor a caller-requested degree band: degrees -> lattice units
+        from ..core.index.h3.constants import M_SQRT7, RES0_U_GNOMONIC
+        scale = M_SQRT7 ** idx.res / RES0_U_GNOMONIC
+        err_lat = max(err_lat, margin_eps_deg * np.pi / 180.0 * scale)
+    far_lim = np.float32(idx.ext_deg + 0.05)
+
+    def fn(points):
+        face, ai, bi, margin, facegap = project_lattice_jax(
+            points, idx.res, idx.origin, precision=precision)
+        far = (jnp.abs(points[..., 0]) > far_lim) | \
+            (jnp.abs(points[..., 1]) > far_lim)
+        ia = ai - idx.a0
+        ib = bi - idx.b0
+        inw = ((face == idx.face0) & (ia >= 0) & (ia < idx.W) &
+               (ib >= 0) & (ib < idx.H))
+        lidx = jnp.where(inw, ia * idx.H + ib, 0)
+        e = jnp.where(inw, idx.entry[lidx], jnp.int32(-1))
+        is_core = (e >= 0) & ((e & CORE_FLAG) != 0)
+        zone_core = jnp.where(is_core, e & ~CORE_FLAG, jnp.int32(-1))
+        is_border = (e >= 0) & ~is_core
+
+        g = jnp.where(is_border, e, 0)
+        rec = idx.pool[g]                               # [N, E, 5]
+        ax, ay = rec[..., 0], rec[..., 1]
+        bx, by = rec[..., 2], rec[..., 3]
+        zs = rec[..., 4].astype(jnp.int32)
+        px = points[..., None, 0]
+        py = points[..., None, 1]
+        straddle = (ay <= py) != (by <= py)
+        t = (py - ay) / jnp.where(by == ay, jnp.ones_like(by), by - ay)
+        xi = ax + t * (bx - ax)
+        crossed = straddle & (px < xi)
+        near_cross = straddle & (jnp.abs(px - xi) < eps)
+        near_vertex = (jnp.abs(py - ay) < eps) & \
+            (px < jnp.maximum(ax, bx) + eps)
+        edge_flag = jnp.any(near_cross | near_vertex, axis=-1) & is_border
+
+        inside = []
+        for z in range(Z):
+            cnt = jnp.sum(crossed & (zs == z), axis=-1)
+            inside.append((cnt & 1).astype(bool))
+        inside = jnp.stack(inside, axis=-1)             # [N, Z]
+        first = jnp.argmax(inside, axis=-1)
+        any_in = jnp.any(inside, axis=-1)
+        gz = idx.gzones[g]                              # [N, Z]
+        zone_border = jnp.where(
+            any_in & is_border,
+            jnp.take_along_axis(gz, first[..., None], axis=-1)[..., 0],
+            jnp.int32(-1))
+
+        zone = jnp.where(is_core, zone_core, zone_border)
+        uncertain = (margin < np.float32(err_lat)) | \
+            (facegap < np.float32(FACEGAP_EPS)) | edge_flag
+        zone = jnp.where(far, jnp.int32(-1), zone)
+        uncertain = uncertain & ~far
+        return zone, uncertain
+
+    return fn
+
+
+def host_recheck_fn(idx: DensePIPIndex):
+    """Vectorized f64 host recheck bound to a dense index.
+
+    Returns ``recheck(points64_abs, zone, uncertain) -> zone`` that
+    reruns the flagged points through the SAME chip semantics in f64 —
+    exact cell assignment (host lattice), exact crossing parity against
+    the original unquantized chip edges.  Replaces the per-polygon
+    Python loop (round-2 host_recheck) that VERDICT.md flagged as
+    unscalable: this is a handful of numpy passes over the flagged
+    subset."""
+    aux = idx.aux
+    assert aux is not None, "recheck needs the build-time aux tables"
+    entry = np.asarray(idx.entry)
+    Z = int(idx.gzones.shape[1])
+
+    def recheck(points64: np.ndarray, zone: np.ndarray,
+                uncertain: np.ndarray) -> np.ndarray:
+        sel = np.nonzero(uncertain)[0]
+        if len(sel) == 0:
+            return zone
+        zone = np.asarray(zone).copy()
+        pts = np.asarray(points64)[sel]
+        face, a, b = _host_lattice(aux["grid"], pts, idx.res)
+        ia = a - idx.a0
+        ib = b - idx.b0
+        inw = ((face == idx.face0) & (ia >= 0) & (ia < idx.W) &
+               (ib >= 0) & (ib < idx.H))
+        e = np.where(inw, entry[np.where(inw, ia * idx.H + ib, 0)], -1)
+        out = np.full(len(sel), -1, np.int32)
+        is_core = (e >= 0) & ((e & int(CORE_FLAG)) != 0)
+        out[is_core] = (e[is_core] & ~int(CORE_FLAG))
+
+        isb = (e >= 0) & ~is_core
+        bsel = np.nonzero(isb)[0]
+        if len(bsel):
+            g = e[bsel].astype(np.int64)
+            gstart = aux["gstart"]
+            cnt = (gstart[g + 1] - gstart[g]).astype(np.int64)
+            total = int(cnt.sum())
+            pidx = np.repeat(np.arange(len(bsel)), cnt)
+            estart = np.repeat(gstart[g], cnt)
+            local = np.arange(total) - np.repeat(
+                np.concatenate([[0], np.cumsum(cnt)[:-1]]), cnt)
+            eidx = estart + local
+            pa = aux["flat_a"][eidx]
+            pb = aux["flat_b"][eidx]
+            zsl = aux["edge_zslot"][eidx]
+            P = pts[bsel][pidx]
+            ay, by = pa[:, 1], pb[:, 1]
+            straddle = (ay <= P[:, 1]) != (by <= P[:, 1])
+            denom = np.where(by == ay, 1.0, by - ay)
+            xi = pa[:, 0] + (P[:, 1] - ay) / denom * (pb[:, 0] - pa[:, 0])
+            crossed = straddle & (P[:, 0] < xi)
+            counts = np.bincount(pidx * Z + zsl, weights=crossed,
+                                 minlength=len(bsel) * Z)
+            odd = (counts.reshape(len(bsel), Z).astype(np.int64) & 1)\
+                .astype(bool)
+            anyin = odd.any(axis=1)
+            first = odd.argmax(axis=1)
+            gz = aux["gzones64"][g, first]
+            out[bsel[anyin]] = gz[anyin].astype(np.int32)
+        zone[sel] = out
+        return zone
+
+    return recheck
 
 
 def pip_host_truth(points64: np.ndarray,

@@ -1,0 +1,83 @@
+"""Stable vector-form gnomonic projection (h3/hexmath.py, h3/jaxkernel.py).
+
+The round-3 rewrite replaced the polar (arccos/atan2) projection whose
+conditioning cost ~3 m of cell-assignment uncertainty.  These tests pin:
+host vector form == host polar form; the device f64 path's margin
+contract (every device/host cell disagreement is flagged by a margin
+below err_lattice_bound); lattice→cell-id aggregation parity.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mosaic_tpu.core.index.h3 import hexmath as hm
+from mosaic_tpu.core.index.h3 import index as ix
+from mosaic_tpu.core.index.h3.jaxkernel import (cell_from_lattice_jax,
+                                                err_lattice_bound,
+                                                pick_precision,
+                                                project_lattice_jax)
+
+
+@pytest.fixture(scope="module")
+def sphere_pts(rng=None):
+    r = np.random.default_rng(9)
+    n = 50_000
+    lat = np.arcsin(r.uniform(-1, 1, n))
+    lng = r.uniform(-np.pi, np.pi, n)
+    return np.stack([lat, lng], axis=-1)
+
+
+@pytest.mark.parametrize("res", [0, 5, 9, 15])
+def test_host_vector_equals_polar(sphere_pts, res):
+    f1, h1 = hm.geo_to_hex2d(sphere_pts, res)
+    f2, h2 = hm.project_lattice(sphere_pts, res)
+    assert np.array_equal(f1, f2)
+    assert np.max(np.abs(h1 - h2)) / hm.M_SQRT7 ** res < 1e-9
+
+
+def test_cpu_auto_precision_is_f64():
+    assert pick_precision("auto") == "f64"
+
+
+@pytest.mark.parametrize("res", [7, 9, 12])
+def test_device_margin_contract_local(res):
+    """f64 device path, origin-localized input: any cell disagreement
+    with the host f64 truth must carry a margin below the bound."""
+    r = np.random.default_rng(11)
+    origin = np.array([-74.0, 40.7])
+    n = 200_000
+    loc = np.stack([r.uniform(-0.4, 0.4, n),
+                    r.uniform(-0.3, 0.3, n)], -1)
+    latlng = np.radians((loc + origin[None])[:, ::-1])
+    fh, hex2d = hm.project_lattice(latlng, res)
+    ijk = hm.hex2d_to_ijk(hex2d)
+    ah, bh = ijk[:, 0] - ijk[:, 2], ijk[:, 1] - ijk[:, 2]
+
+    fd, ad, bd, margin, gap = [np.asarray(v) for v in jax.jit(
+        lambda p: project_lattice_jax(p, res, origin, precision="f64"))(
+        jnp.asarray(loc, jnp.float32))]
+    dis = ~((fd == fh) & (ad == ah) & (bd == bh))
+    bound = err_lattice_bound(res, "f64", 0.4)
+    assert not np.any(dis & (margin >= bound))
+
+
+def test_lattice_aggregation_id_parity():
+    """(face, a, b) -> cell id matches the host encoder end to end."""
+    r = np.random.default_rng(13)
+    n = 100_000
+    lat = np.arcsin(r.uniform(-1, 1, n))
+    lng = r.uniform(-np.pi, np.pi, n)
+    latlng = np.stack([lat, lng], axis=-1)
+    for res in (0, 3, 9):
+        fh, hex2d = hm.project_lattice(latlng, res)
+        ijk = hm.hex2d_to_ijk(hex2d)
+        ah = (ijk[:, 0] - ijk[:, 2]).astype(np.int32)
+        bh = (ijk[:, 1] - ijk[:, 2]).astype(np.int32)
+        ids = np.asarray(jax.jit(
+            lambda f, a, b: cell_from_lattice_jax(f, a, b, res))(
+            jnp.asarray(fh.astype(np.int32)), jnp.asarray(ah),
+            jnp.asarray(bh)))
+        want = ix.latlng_to_cell(latlng, res)
+        assert np.array_equal(ids, want)

@@ -1,0 +1,84 @@
+"""Double-single f32 arithmetic (ops/twofloat.py).
+
+Exactness is asserted in eager mode: each op is its own compiled module
+there, so XLA cannot contract/reassociate across the Dekker sequences.
+Under a fused jit, XLA:CPU compiles `t1 - p` into fma(ahi, bhi, -p) and
+similar, collapsing df to ~f32 — that platform caveat is exactly why
+jaxkernel.pick_precision routes CPU to the native-f64 path; the jit-mode
+assertions here only require the f32-level floor that even the collapsed
+form guarantees.  The TPU lane (test_tpu.py) asserts full df precision
+under jit on hardware where the transforms survive.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mosaic_tpu.ops import twofloat as tf
+
+
+def total(df):
+    return np.asarray(df.hi, np.float64) + np.asarray(df.lo, np.float64)
+
+
+@pytest.fixture
+def vals():
+    rng = np.random.default_rng(1)
+    return rng.uniform(-2.0, 2.0, 64).astype(np.float32)
+
+
+def test_two_sum_exact(vals):
+    a = jnp.asarray(vals)
+    b = jnp.asarray(vals[::-1].copy() * np.float32(1e-4))
+    s, e = tf.two_sum(a, b)
+    got = np.asarray(s, np.float64) + np.asarray(e, np.float64)
+    want = vals.astype(np.float64) + (vals[::-1] * np.float32(1e-4)
+                                      ).astype(np.float64)
+    assert np.array_equal(got, want)
+
+
+def test_two_prod_exact(vals):
+    a = jnp.asarray(vals)
+    b = jnp.asarray(vals[::-1].copy())
+    p, e = tf.two_prod(a, b)
+    got = np.asarray(p, np.float64) + np.asarray(e, np.float64)
+    want = vals.astype(np.float64) * vals[::-1].astype(np.float64)
+    assert np.array_equal(got, want)
+
+
+def test_df_mul_precision(vals):
+    x = tf.df_const(np.pi / 180.0)
+    r = tf.df_mul(tf.df_from_f32(jnp.asarray(vals)), x)
+    want = vals.astype(np.float64) * np.pi / 180.0
+    assert np.max(np.abs(total(r) - want)) < 1e-10
+
+
+def test_df_div_precision(vals):
+    num = tf.df_const(1.0)
+    den_v = np.abs(vals) + np.float32(0.5)      # f32-rounded denominator
+    den = tf.df_from_f32(jnp.asarray(den_v))
+    r = tf.df_div(num, den)
+    want = 1.0 / den_v.astype(np.float64)
+    assert np.max(np.abs(total(r) - want) / np.abs(want)) < 1e-12
+
+
+def test_df_trig_small_angle():
+    d = np.linspace(-0.04, 0.04, 101).astype(np.float32)
+    df = tf.df_mul(tf.df_from_f32(jnp.asarray(d)), tf.df_const(1.0))
+    s = tf.df_poly_sin(df)
+    c = tf.df_poly_cos(df)
+    assert np.max(np.abs(total(s) - np.sin(d.astype(np.float64)))) < 1e-12
+    assert np.max(np.abs(total(c) - np.cos(d.astype(np.float64)))) < 1e-12
+
+
+def test_df_round_carries_residual():
+    v = np.array([1234.4999, -77.5001, 0.49997], np.float64)
+    hi = v.astype(np.float32)
+    lo = (v - hi.astype(np.float64)).astype(np.float32)
+    r, frac = tf.df_round(tf.DF(jnp.asarray(hi), jnp.asarray(lo)))
+    want_r = np.round(v)
+    got = np.asarray(r, np.float64)
+    # round-half-to-even vs true value: both residual decompositions must
+    # reconstruct v
+    assert np.allclose(got + np.asarray(frac, np.float64), v, atol=1e-7)
+    assert np.max(np.abs(got - want_r)) <= 1.0

@@ -1,0 +1,94 @@
+"""Real-TPU numerics: the contracts the CPU suite cannot check.
+
+1. Double-single (df) arithmetic survives the TPU compiler under jit —
+   XLA:CPU contracts `t1 - p` into fma and collapses df to f32 (see
+   ops/twofloat.py); the df-on-TPU design depends on the TPU compiler
+   NOT doing that.  If this test fails, the PIP join must stop using
+   precision="df" and fall back to "f32" with its wider margin band.
+2. The df-local projection's margin contract on device.
+3. The dense PIP join end-to-end against the f64 host oracle.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jaxmod():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def test_df_survives_tpu_jit(jaxmod):
+    jax = jaxmod
+    import jax.numpy as jnp
+    from mosaic_tpu.ops import twofloat as tf
+
+    rng = np.random.default_rng(2)
+    vals = rng.uniform(-2.0, 2.0, 4096).astype(np.float32)
+    pi180 = tf.df_const(np.pi / 180.0)
+
+    def f(a):
+        d = tf.df_mul(tf.df_from_f32(a), pi180)
+        s = tf.df_poly_sin(d)
+        return s.hi, s.lo
+
+    hi, lo = jax.jit(f)(jnp.asarray(vals))
+    got = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    want = np.sin(vals.astype(np.float64) * np.pi / 180.0)
+    err = np.abs(got - want).max()
+    # df-level: ~1e-12; a collapsed (f32) chain would show ~1e-8
+    assert err < 1e-10, f"df collapsed under TPU jit: {err:.2e}"
+
+
+def test_projection_margin_contract_df(jaxmod):
+    jax = jaxmod
+    import jax.numpy as jnp
+    from mosaic_tpu.core.index.h3 import hexmath as hm
+    from mosaic_tpu.core.index.h3.jaxkernel import (err_lattice_bound,
+                                                    project_lattice_jax)
+
+    r = np.random.default_rng(3)
+    origin = np.array([-74.0, 40.7])
+    res = 9
+    n = 500_000
+    loc = np.stack([r.uniform(-0.4, 0.4, n),
+                    r.uniform(-0.3, 0.3, n)], -1)
+    latlng = np.radians((loc + origin[None])[:, ::-1])
+    fh, hex2d = hm.project_lattice(latlng, res)
+    ijk = hm.hex2d_to_ijk(hex2d)
+    ah, bh = ijk[:, 0] - ijk[:, 2], ijk[:, 1] - ijk[:, 2]
+    fd, ad, bd, margin, gap = [np.asarray(v) for v in jax.jit(
+        lambda p: project_lattice_jax(p, res, origin, precision="df"))(
+        jnp.asarray(loc, jnp.float32))]
+    dis = ~((fd == fh) & (ad == ah) & (bd == bh))
+    bound = err_lattice_bound(res, "df", 0.4)
+    unflagged = dis & (margin >= bound)
+    assert unflagged.sum() == 0, (
+        f"{unflagged.sum()} unflagged disagreements; worst margin "
+        f"{margin[dis].max():.3e} vs bound {bound:.3e}")
+
+
+def test_dense_join_parity_on_tpu(jaxmod):
+    jax = jaxmod
+    import jax.numpy as jnp
+    from mosaic_tpu.bench.workloads import build_workload, nyc_points
+    from mosaic_tpu.parallel.pip_join import (DensePIPIndex,
+                                              build_pip_index,
+                                              host_recheck_fn, localize,
+                                              make_pip_join_fn,
+                                              pip_host_truth)
+
+    polys, grid, res = build_workload(n_side=5, grid_name="H3",
+                                      zones="taxi")
+    idx = build_pip_index(polys, res, grid)
+    assert isinstance(idx, DensePIPIndex)
+    fn = jax.jit(make_pip_join_fn(idx, grid))
+    pts64 = nyc_points(100_000, seed=7)
+    zone, unc = fn(jnp.asarray(localize(idx, pts64)))
+    zone, unc = np.asarray(zone), np.asarray(unc)
+    final = host_recheck_fn(idx)(pts64, zone, unc)
+    truth = pip_host_truth(pts64, polys)
+    assert np.array_equal(final, truth)
+    assert unc.mean() < 5e-3

@@ -1,0 +1,101 @@
+"""Component-level timing of the PIP join on the real device."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def timeit(fn, *args, iters=5):
+    out = jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.time()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.time() - t0)
+    return float(np.median(ts)), out
+
+
+def main():
+    from mosaic_tpu.bench.workloads import build_workload, nyc_points
+    from mosaic_tpu.parallel.pip_join import (build_pip_index, localize,
+                                              make_pip_join_fn, pip_assign,
+                                              _chip_pip, zone_histogram)
+    from mosaic_tpu.ops.lookup import lookup
+
+    platform = jax.devices()[0].platform
+    log("platform:", platform)
+    t0 = time.time()
+    polys, grid, res = build_workload(n_side=16, grid_name="H3",
+                                      zones="taxi")
+    idx = build_pip_index(polys, res, grid)
+    log(f"index build {time.time()-t0:.1f}s; chip_a shape "
+        f"{idx.chip_a.shape}, core {idx.core_cells.shape}, "
+        f"border {idx.border_cells.shape}, max_dup {idx.max_dup}")
+    edge_counts = np.asarray(idx.chip_mask).sum(1)
+    log("edges/chip: mean %.1f p50 %d p90 %d p99 %d max %d" % (
+        edge_counts.mean(), *np.percentile(edge_counts,
+                                           [50, 90, 99, 100]).astype(int)))
+
+    n = 1 << 22
+    pts64 = nyc_points(n)
+    pts = jnp.asarray(localize(idx, pts64))
+
+    # 1. cell assignment alone
+    def cells_fn(p):
+        absolute = p + idx.origin.astype(p.dtype)
+        return grid.point_to_cell_jax_margin(absolute, idx.res)
+    f1 = jax.jit(cells_fn)
+    t, (cells, margin) = timeit(f1, pts)
+    log(f"cell assignment: {t*1e3:.1f} ms ({n/t/1e6:.1f}M pts/s)")
+
+    # 2. lookups alone
+    cells = jax.block_until_ready(cells)
+
+    def lookups_fn(c):
+        s1, f1_ = lookup(idx.core_cells, c)
+        s2, f2_ = lookup(idx.border_cells, c)
+        return s1, f1_, s2, f2_
+    t, _ = timeit(jax.jit(lookups_fn), cells)
+    log(f"two lookups: {t*1e3:.1f} ms")
+
+    # 3. single-dup chip pip (gather + parity + d2)
+    s0 = jnp.zeros(n, jnp.int32)
+
+    def one_dup(p, s):
+        return _chip_pip(p, idx, s)
+    t, _ = timeit(jax.jit(one_dup), pts, s0)
+    log(f"one _chip_pip dup (zero slots): {t*1e3:.1f} ms")
+
+    # random slots (realistic scattered gather)
+    sr = jnp.asarray(np.random.default_rng(0).integers(
+        0, idx.num_chips, n, dtype=np.int32))
+    t, _ = timeit(jax.jit(one_dup), pts, sr)
+    log(f"one _chip_pip dup (random slots): {t*1e3:.1f} ms")
+
+    # 4. full pip_assign
+    def assign_fn(p, c):
+        return pip_assign(p, c, idx)
+    t, _ = timeit(jax.jit(assign_fn), pts, cells)
+    log(f"pip_assign (all {idx.max_dup} dups): {t*1e3:.1f} ms")
+
+    # 5. full join
+    join = make_pip_join_fn(idx, grid)
+    t, _ = timeit(jax.jit(join), pts)
+    log(f"full join: {t*1e3:.1f} ms ({n/t/1e6:.2f}M pts/s)")
+
+    # 6. full join + histogram (bench step)
+    def step(p):
+        zone, unc = join(p)
+        return zone, zone_histogram(zone, len(polys)), jnp.sum(unc)
+    t, _ = timeit(jax.jit(step), pts)
+    log(f"bench step: {t*1e3:.1f} ms ({n/t/1e6:.2f}M pts/s)")
+
+
+if __name__ == "__main__":
+    main()

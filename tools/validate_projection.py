@@ -1,0 +1,134 @@
+"""Validate the H3 projection rewrite and measure its error bounds.
+
+Three checks:
+1. host project_lattice (vector form) == geo_to_hex2d (polar form), f64.
+2. device project_lattice_jax cells == host f64 cells wherever the margin
+   exceeds the claimed error bound (both input paths).
+3. empirical max planar-lattice error of the device paths vs host f64 —
+   the numbers behind jaxkernel.ERR_LATTICE_DF / ERR_LATTICE_ABS.
+
+Run with JAX_PLATFORMS=cpu for fast iteration and on the TPU to confirm
+device numerics (division/transcendental lowering differs).
+"""
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    from mosaic_tpu.core.index.h3 import hexmath as hm
+    from mosaic_tpu.core.index.h3 import index as ix
+    from mosaic_tpu.core.index.h3.jaxkernel import (cell_from_lattice_jax,
+                                                    project_lattice_jax)
+
+    rng = np.random.default_rng(3)
+
+    # ---- 1. host vector form vs polar form
+    n = 200_000
+    lat = np.arcsin(rng.uniform(-1, 1, n))
+    lng = rng.uniform(-np.pi, np.pi, n)
+    latlng = np.stack([lat, lng], axis=-1)
+    for res in (0, 1, 7, 9, 15):
+        f1, h1 = hm.geo_to_hex2d(latlng, res)
+        f2, h2 = hm.project_lattice(latlng, res)
+        assert np.array_equal(f1, f2)
+        scale = hm.M_SQRT7 ** res
+        rel = np.max(np.abs(h1 - h2)) / scale
+        log(f"res {res}: host polar-vs-vector max diff {rel:.2e} "
+            f"(lattice/scale units)")
+        assert rel < 1e-9, rel
+
+    # ---- 2+3. device paths vs host f64
+    for res in (7, 9, 11):
+        # city-scale window (the df path's regime)
+        origin = np.array([-74.0, 40.7])
+        m = 2_000_000
+        loc = np.stack([rng.uniform(-0.4, 0.4, m),
+                        rng.uniform(-0.3, 0.3, m)], axis=-1)
+        abs_deg = loc + origin[None]
+        latlng = np.radians(abs_deg[:, ::-1])
+        fh, hex2d = hm.project_lattice(latlng, res)
+        ijk = hm.hex2d_to_ijk(hex2d)
+        ah = (ijk[:, 0] - ijk[:, 2]).astype(np.int64)
+        bh = (ijk[:, 1] - ijk[:, 2]).astype(np.int64)
+
+        from mosaic_tpu.core.index.h3.jaxkernel import err_lattice_bound
+
+        def mk(prec, localized):
+            if localized:
+                return (jax.jit(lambda p: project_lattice_jax(
+                    p, res, origin, precision=prec)),
+                    jnp.asarray(loc, jnp.float32),
+                    err_lattice_bound(res, prec, 0.4, localized=True))
+            return (jax.jit(lambda p: project_lattice_jax(
+                p, res, precision=prec)),
+                jnp.asarray(abs_deg, jnp.float32),
+                err_lattice_bound(res, prec, 75.0, localized=False))
+
+        fns = {
+            "df-local": mk("df", True),
+            "df-abs": mk("df", False),
+            "f64-local": mk("f64", True),
+            "f64-abs": mk("f64", False),
+        }
+        for name, (fn, pts, bound) in fns.items():
+            fd, ad, bd, margin, gap = [np.asarray(v) for v in fn(pts)]
+            # planar error: host exact planar pos vs device lattice pick
+            # (device residual vector reconstructs its planar estimate)
+            same = (fd == fh) & (ad == ah) & (bd == bh)
+            # max planar deviation: |device planar - host planar| via the
+            # disagreement margin: for agreeing points, device planar =
+            # lattice + residual; host planar known exactly.
+            dev_planar_q = ad - bd + 0.0
+            dev_planar_r = bd + 0.0
+            # host axial float coords
+            qf = hex2d[:, 0] - 0.5 * (hex2d[:, 1] / hm.M_SIN60)
+            rf = hex2d[:, 1] / hm.M_SIN60
+            # device float estimate = its lattice point + residual is not
+            # returned; bound error instead by margin consistency:
+            host_q = qf
+            host_r = rf
+            # error proxy: for disagreeing cells, host margin must be tiny
+            disq = ~same
+            host_fq = host_q - np.round(host_q)
+            host_fr = host_r - np.round(host_r)
+            vx = host_fq + 0.5 * host_fr
+            vy = hm.M_SIN60 * host_fr
+            proj = np.maximum(np.abs(vx), np.maximum(
+                np.abs(0.5 * vx + hm.M_SIN60 * vy),
+                np.abs(0.5 * vx - hm.M_SIN60 * vy)))
+            host_margin = np.maximum(0.5 - proj, 0)
+            worst = np.max(host_margin[disq]) if disq.any() else 0.0
+            worst_dev = np.max(margin[disq]) if disq.any() else 0.0
+            ok = "OK" if max(worst, worst_dev) < bound else "FAIL"
+            log(f"res {res} path {name}: {disq.sum()}/{m} cell "
+                f"disagreements, worst host-margin {worst:.3e} / "
+                f"worst device-margin {worst_dev:.3e} vs bound "
+                f"{bound:.3e} -> {ok}")
+            # df bounds only hold where the compiler preserves Dekker
+            # transforms (TPU); XLA:CPU collapses df to ~f32 (see
+            # jaxkernel.pick_precision), so only f64 is asserted there.
+            if name.startswith("f64") or jax.default_backend() != "cpu":
+                assert max(worst, worst_dev) < bound, (name, res)
+            # and full cell-id parity through aggregation where safe
+            cd = np.asarray(jax.jit(cell_from_lattice_jax,
+                                    static_argnums=(3,))(
+                jnp.asarray(fd), jnp.asarray(ad), jnp.asarray(bd), res))
+            ch = ix.latlng_to_cell(latlng[:200_000], res)
+            eq = cd[:200_000] == ch
+            bad = ~eq & same[:200_000]
+            log(f"   id parity on agreeing lattice: "
+                f"{bad.sum()} mismatches of 200k")
+            assert bad.sum() == 0
+
+
+if __name__ == "__main__":
+    main()
