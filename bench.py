@@ -81,15 +81,29 @@ def main():
                                               pip_host_truth,
                                               zone_histogram)
 
+    from mosaic_tpu.core.tessellate import tessellate
+
     platform = jax.devices()[0].platform
-    t0 = time.time()
     polys, grid, res = build_workload(n_side=16, grid_name="H3",
                                       zones="taxi")
-    idx = build_pip_index(polys, res, grid)
+    tessellate(polys.take([0]), res, grid)        # warm lattice tables
+    t0 = time.time()
+    chips = tessellate(polys, res, grid, keep_core_geom=False)
+    t_tess = time.time() - t0
+    idx = build_pip_index(polys, res, grid, chips=chips)
     dense = isinstance(idx, DensePIPIndex)
-    log(f"tessellated {len(polys)} zones -> "
-        f"{type(idx).__name__} ({idx.num_chips} border groups) "
-        f"in {time.time()-t0:.1f}s")
+    log(f"tessellated {len(polys)} zones -> {len(chips)} chips in "
+        f"{t_tess:.1f}s; index {type(idx).__name__} "
+        f"({idx.num_chips} border groups)")
+
+    # BASELINE config 2: US-county-scale chip generation (host engine)
+    from mosaic_tpu.bench.workloads import conus_counties
+    counties = conus_counties()
+    t0 = time.time()
+    cchips = tessellate(counties, 5, grid, keep_core_geom=False)
+    t_counties = time.time() - t0
+    log(f"counties: {len(counties)} polys -> {len(cchips)} chips "
+        f"(res 5) in {t_counties:.1f}s")
 
     join = make_pip_join_fn(idx, grid)
     n_zones = len(polys)
@@ -162,6 +176,9 @@ def main():
         "device_ms": round(dt_dev * 1e3, 1),
         "end_to_end_ms": round(dt * 1e3, 1),
         "uncertain_frac": round(unc_frac, 8),
+        "tessellate_zones_s": round(t_tess, 2),
+        "tessellate_counties_s": round(t_counties, 2),
+        "county_chips": len(cchips),
     }))
 
 

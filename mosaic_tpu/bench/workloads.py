@@ -22,6 +22,17 @@ from ..core.index.custom import CustomIndexSystem, GridConf
 
 # NYC-ish bbox (lon/lat)
 NYC = (-74.30, 40.45, -73.65, 40.95)
+# CONUS bbox (lon/lat) for the US-county-scale workload
+CONUS = (-124.7, 24.5, -66.9, 49.4)
+
+
+def conus_counties(n_side: int = 56, seed: int = 23) -> "GeometryArray":
+    """~3.1k-polygon partition of the CONUS bbox with fractal boundaries —
+    the US-county stand-in for BASELINE.md config 2 (grid_tessellate on
+    county polygons).  Reuses the taxi-zone generator at continental
+    scale; hole/merge features off (counties are simple polygons)."""
+    return taxi_zones(n_side=n_side, seed=seed, bbox=CONUS,
+                      hole_every=0, merge_every=0)
 
 
 def nyc_zones(n_side: int = 16, seed: int = 7,

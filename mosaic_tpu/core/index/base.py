@@ -107,6 +107,23 @@ class IndexSystem(abc.ABC):
         exactly.  Replaces the reference's buffer-radius + polyfill
         candidate generation (core/Mosaic.scala:61-99)."""
 
+    def candidate_cells_batch(self, bboxes: np.ndarray, res: int,
+                              max_cells: int = 4_000_000) -> list:
+        """candidate_cells for G bboxes at once: [G, 4] -> list of G int64
+        arrays.  Default loops; grids whose candidate generation has
+        per-call fixed costs (H3's dense sample lattice re-encodes the
+        same cells for every overlapping bbox) override with a shared
+        pass — profiling showed per-geometry candidate generation was
+        67% of tessellation time on the 281-zone bench workload."""
+        out = []
+        for g in range(len(bboxes)):
+            bb = bboxes[g]
+            if np.any(np.isnan(bb)):
+                out.append(np.empty(0, np.int64))
+            else:
+                out.append(self.candidate_cells(bb, res, max_cells))
+        return out
+
     # ------------------------------------------------------- derived ops
     def cell_area(self, cells: np.ndarray) -> np.ndarray:
         """[N] planar area in CRS units² (reference: IndexSystem.area uses

@@ -94,7 +94,9 @@ def latlng_to_cell(latlng: np.ndarray, res: int) -> np.ndarray:
     t = tables()
     latlng = np.atleast_2d(np.asarray(latlng, np.float64))
     n = len(latlng)
-    f, hex2d = hm.geo_to_hex2d(latlng, res)
+    # vector-form projection: same frame/values as geo_to_hex2d (polar)
+    # to 1e-13, without the arccos/atan2 cost (tests/test_projection.py)
+    f, hex2d = hm.project_lattice(latlng, res)
     cur = hm.hex2d_to_ijk(hex2d)
     digits = np.zeros((n, max(res, 1)), np.int64)
     for r in range(res, 0, -1):

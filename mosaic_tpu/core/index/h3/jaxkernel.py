@@ -85,11 +85,12 @@ def pick_precision(precision: str = "auto") -> str:
     if precision != "auto":
         return precision
     import jax
-    if jax.default_backend() == "cpu":
-        # never df on CPU (see above); without x64 fall back to plain
-        # f32 and its wide margin band rather than silently-collapsed df
-        return "f64" if jax.config.jax_enable_x64 else "f32"
-    return "df"
+    if jax.default_backend() in ("tpu", "axon"):
+        return "df"
+    # df survival was only measured on the TPU compiler; XLA:CPU (and
+    # likely XLA:GPU) contract the Dekker transforms, so every other
+    # backend gets native f64 (or plain f32 with its wide margin band)
+    return "f64" if jax.config.jax_enable_x64 else "f32"
 
 
 def err_lattice_bound(res: int, precision: str,

@@ -120,31 +120,128 @@ class H3IndexSystem(IndexSystem):
             self._circum_deg[res] = float(circum) * 1.1
         return self._inradius_deg[res], self._circum_deg[res]
 
+    #: |lat| band edges where cos shrinks by 1.1 per step: within a band
+    #: the lon sample spacing tuned for the band's widest-cos edge stays
+    #: within sqrt(2)*inr of what ANY row in the band needs (the single
+    #: whole-bbox cos previously under-sampled low latitudes on spans
+    #: reaching high latitude — silently dropping candidate cells)
+    _LAT_BANDS = np.degrees(np.arccos(np.minimum(
+        1.0 / 1.1 ** np.arange(0, 60), 1.0)))
+
+    def _band_lattices(self, x0: float, y0: float, x1: float, y1: float,
+                       inr: float) -> list:
+        """Split [y0, y1] at the |lat| band edges; per band return a
+        regular lattice spec (x0, yb0, sx, sy, nx, ny) whose x-spacing
+        is safe for every row in the band."""
+        cuts = np.concatenate([-self._LAT_BANDS, self._LAT_BANDS, [90.0],
+                               [-90.0]])
+        cuts = np.unique(cuts[(cuts > y0) & (cuts < y1)])
+        edges = np.concatenate([[y0], cuts, [y1]])
+        sy = 1.2 * inr
+        out = []
+        for a, b in zip(edges[:-1], edges[1:]):
+            min_abs = 0.0 if a < 0 < b else min(abs(a), abs(b))
+            coslat = max(np.cos(np.radians(min_abs)), 1e-3)
+            sx = 1.2 * inr / coslat
+            nx = int(np.ceil((x1 - x0) / sx)) + 1
+            ny = int(np.ceil((b - a) / sy)) + 1
+            out.append((x0, float(a), sx, sy, nx, ny))
+        return out
+
     def candidate_cells(self, bbox: np.ndarray, res: int,
                         max_cells: int = 4_000_000) -> np.ndarray:
         """Cells possibly intersecting a lon/lat bbox, by lattice-dense
         point sampling + dedupe (every cell contains a disk of its
-        inradius, so a sample grid at that spacing hits every cell)."""
+        inradius; spacing 1.2*inr per latitude band keeps the sample
+        half-diagonal at most ~0.9*inr for every row, so each cell's
+        inscribed disk contains a sample)."""
         self._check_res(res)
         inr, circ = self._cell_metrics_deg(res)
         x0, y0, x1, y1 = (float(bbox[0]) - circ, float(bbox[1]) - circ,
                           float(bbox[2]) + circ, float(bbox[3]) + circ)
         y0, y1 = max(y0, -90.0), min(y1, 90.0)
-        coslat = max(np.cos(np.radians(max(abs(y0), abs(y1)))), 1e-3)
-        sx = inr / coslat / np.sqrt(2.0)
-        sy = inr / np.sqrt(2.0)
-        nx = int(np.ceil((x1 - x0) / sx)) + 1
-        ny = int(np.ceil((y1 - y0) / sy)) + 1
-        if nx * ny > 4 * max_cells:
-            raise ValueError(f"bbox needs {nx * ny} samples at res {res}")
-        gx, gy = np.meshgrid(x0 + np.arange(nx) * sx,
-                             y0 + np.arange(ny) * sy, indexing="ij")
-        pts = np.stack([gx.ravel(), gy.ravel()], axis=-1)
-        cells = np.unique(self.point_to_cell(pts, res))
+        bands = self._band_lattices(x0, y0, x1, y1, inr)
+        total = sum(nx * ny for *_, nx, ny in bands)
+        if total > 4 * max_cells:
+            raise ValueError(f"bbox needs {total} samples at res {res}")
+        pts = []
+        for bx0, by0, sx, sy, nx, ny in bands:
+            gx, gy = np.meshgrid(bx0 + np.arange(nx) * sx,
+                                 by0 + np.arange(ny) * sy, indexing="ij")
+            pts.append(np.stack([gx.ravel(), gy.ravel()], axis=-1))
+        cells = np.unique(self.point_to_cell(np.concatenate(pts), res))
         if len(cells) > max_cells:
             raise ValueError(
                 f"bbox covers {len(cells)} cells at res {res}")
         return cells
+
+    def candidate_cells_batch(self, bboxes: np.ndarray, res: int,
+                              max_cells: int = 4_000_000) -> list:
+        """Shared-lattice batch candidate generation.
+
+        The per-bbox path re-encodes a dense sample lattice per call;
+        for a polygon batch tiling one region (the normal tessellation
+        input) adjacent bboxes overlap heavily and the same cells get
+        encoded dozens of times.  Here ONE lattice covers the union
+        bbox, latlng_to_cell runs once, and each geometry selects its
+        sample rows/cols by index arithmetic.  Falls back to the
+        per-bbox loop when the union is much larger than the sum of
+        parts (sparse, far-apart geometries)."""
+        bboxes = np.asarray(bboxes, np.float64)
+        ok = ~np.any(np.isnan(bboxes), axis=1)
+        if ok.sum() < 2:
+            return super().candidate_cells_batch(bboxes, res, max_cells)
+        self._check_res(res)
+        inr, circ = self._cell_metrics_deg(res)
+        padded = bboxes.copy()
+        padded[:, 0] -= circ
+        padded[:, 1] -= circ
+        padded[:, 2] += circ
+        padded[:, 3] += circ
+        x0 = np.nanmin(padded[ok, 0])
+        y0 = max(np.nanmin(padded[ok, 1]), -90.0)
+        x1 = np.nanmax(padded[ok, 2])
+        y1 = min(np.nanmax(padded[ok, 3]), 90.0)
+        bands = self._band_lattices(x0, y0, x1, y1, inr)
+        total = sum(nx * ny for *_, nx, ny in bands)
+        sy = 1.2 * inr
+        area_sum = np.sum(
+            np.maximum(padded[ok, 2] - padded[ok, 0], sy) *
+            np.maximum(padded[ok, 3] - padded[ok, 1], sy))
+        if total > 4 * max_cells or \
+                total * (sy * sy) > 6.0 * area_sum:
+            return super().candidate_cells_batch(bboxes, res, max_cells)
+        band_cells = []
+        for bx0, by0, sx, sb, nx, ny in bands:
+            gx, gy = np.meshgrid(bx0 + np.arange(nx) * sx,
+                                 by0 + np.arange(ny) * sb, indexing="ij")
+            band_cells.append(self.point_to_cell(
+                np.stack([gx.ravel(), gy.ravel()], axis=-1),
+                res).reshape(nx, ny))
+        out = []
+        for g in range(len(bboxes)):
+            if not ok[g]:
+                out.append(np.empty(0, np.int64))
+                continue
+            subs = []
+            for (bx0, by0, sx, sb, nx, ny), cells in zip(bands,
+                                                         band_cells):
+                if padded[g, 3] < by0 or \
+                        padded[g, 1] > by0 + (ny - 1) * sb:
+                    continue
+                ix0 = max(int(np.floor((padded[g, 0] - bx0) / sx)), 0)
+                iy0 = max(int(np.floor((padded[g, 1] - by0) / sb)), 0)
+                ix1 = min(int(np.ceil((padded[g, 2] - bx0) / sx)) + 1, nx)
+                iy1 = min(int(np.ceil((padded[g, 3] - by0) / sb)) + 1, ny)
+                if ix0 < ix1 and iy0 < iy1:
+                    subs.append(cells[ix0:ix1, iy0:iy1].ravel())
+            sub = np.unique(np.concatenate(subs)) if subs else \
+                np.empty(0, np.int64)
+            if len(sub) > max_cells:
+                raise ValueError(
+                    f"bbox covers {len(sub)} cells at res {res}")
+            out.append(sub)
+        return out
 
     # ------------------------------------------------------------- area
     def cell_area(self, cells: np.ndarray) -> np.ndarray:

@@ -103,13 +103,20 @@ def classify_cells(cell_verts: np.ndarray, cell_counts: np.ndarray,
     """Classify candidate cells against one polygon's edge soup.
 
     cell_verts [M, K, 2], cell_counts [M], centers [M, 2], edges [E, 2, 2].
-    Returns (touching [M], core [M]).  Processed in blocks of cells to bound
-    the [B, K, E] broadcast.
+    Returns (touching [M], core [M]).
 
     A cell is core only if all its vertices are inside the polygon, no
     polygon edge crosses it, AND no polygon vertex lies inside it — the
     last clause catches rings (holes, or whole multipolygon parts) that sit
     entirely inside one cell and therefore cross no cell boundary.
+
+    The O(M*E) crossing and vertex-in-cell tests only matter for (cell,
+    edge) pairs whose bboxes overlap — a sparse set (each edge overlaps a
+    handful of cells), so both run on the nonzero pairs of a cheap bbox
+    overlap matrix instead of the dense [M, K, E] broadcast (which was
+    half of tessellation time on the 281-zone bench).  The crossing-number
+    tests (center/vertex in polygon) need every edge's parity and stay
+    dense.
     """
     m, kmax = cell_verts.shape[:2]
     touching = np.zeros(m, dtype=bool)
@@ -123,44 +130,56 @@ def classify_cells(cell_verts: np.ndarray, cell_counts: np.ndarray,
     vin = _pip(flat, edges).reshape(m, kmax)
     all_in = np.all(vin | ~vmask, axis=1)
     any_in = np.any(vin & vmask, axis=1)
-    # any polygon vertex inside cell (cells convex: half-plane tests).
-    if len(edges):
-        pv = edges[:, 0, :]                              # [E, 2] all verts
-        nxt = np.take_along_axis(
-            cell_verts,
-            np.where(np.arange(kmax)[None, :, None] + 1 >=
-                     cell_counts[:, None, None], 0,
-                     np.arange(kmax)[None, :, None] + 1), axis=1)
-        e_vec = nxt - cell_verts                          # [M, K, 2]
-        inside_cell = np.zeros(m, dtype=bool)
-        for s in range(0, len(pv), block):
-            pb = pv[s:s + block]                          # [B, 2]
-            p_vec = pb[None, None, :, :] - cell_verts[:, :, None, :]
-            crossz = e_vec[..., None, 0] * p_vec[..., 1] - \
-                e_vec[..., None, 1] * p_vec[..., 0]       # [M, K, B]
-            inside = np.all((crossz >= 0) | ~vmask[:, :, None], axis=1)
-            inside_cell |= np.any(inside, axis=-1)
-    else:
-        inside_cell = np.zeros(m, dtype=bool)
 
-    # edge crossing per block
+    inside_cell = np.zeros(m, dtype=bool)
+    crossed = np.zeros(m, dtype=bool)
     if len(edges):
-        a2 = edges[None, None, :, 0, :]
-        b2 = edges[None, None, :, 1, :]
+        vx = np.where(vmask, cell_verts[..., 0], np.inf)
+        vy = np.where(vmask, cell_verts[..., 1], np.inf)
+        cb = np.stack([vx.min(1), vy.min(1),
+                       np.where(vmask, cell_verts[..., 0],
+                                -np.inf).max(1),
+                       np.where(vmask, cell_verts[..., 1],
+                                -np.inf).max(1)], axis=-1)   # [M, 4]
+        del vx, vy
+        ex0 = np.minimum(edges[:, 0, 0], edges[:, 1, 0])
+        ex1 = np.maximum(edges[:, 0, 0], edges[:, 1, 0])
+        ey0 = np.minimum(edges[:, 0, 1], edges[:, 1, 1])
+        ey1 = np.maximum(edges[:, 0, 1], edges[:, 1, 1])
+        ci_l, ei_l = [], []
         for s in range(0, m, block):
             e0 = min(s + block, m)
-            cv = cell_verts[s:e0]
-            cc = cell_counts[s:e0]
+            ov = (cb[s:e0, 0, None] <= ex1[None, :]) & \
+                 (ex0[None, :] <= cb[s:e0, 2, None]) & \
+                 (cb[s:e0, 1, None] <= ey1[None, :]) & \
+                 (ey0[None, :] <= cb[s:e0, 3, None])
+            a, b = np.nonzero(ov)
+            ci_l.append(a + s)
+            ei_l.append(b)
+        ci = np.concatenate(ci_l)
+        ei = np.concatenate(ei_l)
+        if len(ci):
             k = np.arange(kmax)
-            nxt_idx = np.where(k + 1 >= cc[:, None], 0, k + 1)
-            cv_next = np.take_along_axis(cv, nxt_idx[:, :, None], axis=1)
-            a1 = cv[:, :, None, :]
-            b1 = cv_next[:, :, None, :]
-            hit = _seg_cross(a1, b1, a2, b2)
-            hit &= (k[None, :] < cc[:, None])[:, :, None]
-            touching[s:e0] = np.any(hit, axis=(1, 2))
-    core = all_in & ~touching & ~inside_cell
-    touching = touching | center_in | any_in | inside_cell | core
+            nxt_idx = np.where(k[None, :] + 1 >= cell_counts[:, None], 0,
+                               k[None, :] + 1)
+            cv_next = np.take_along_axis(cell_verts, nxt_idx[:, :, None],
+                                         axis=1)
+            a1 = cell_verts[ci]                       # [P, K, 2]
+            b1 = cv_next[ci]
+            a2 = edges[ei, 0][:, None, :]             # [P, 1, 2]
+            b2 = edges[ei, 1][:, None, :]
+            hit = _seg_cross(a1, b1, a2, b2) & vmask[ci]
+            np.logical_or.at(crossed, ci, hit.any(axis=1))
+            # polygon (start-)vertex inside convex CCW cell
+            ev = cv_next - cell_verts                 # [M, K, 2]
+            pvec = edges[ei, 0][:, None, :] - a1      # [P, K, 2]
+            crossz = ev[ci][..., 0] * pvec[..., 1] - \
+                ev[ci][..., 1] * pvec[..., 0]
+            inside = np.all((crossz >= 0) | ~vmask[ci], axis=1)
+            np.logical_or.at(inside_cell, ci, inside)
+
+    core = all_in & ~crossed & ~inside_cell
+    touching = crossed | center_in | any_in | inside_cell | core
     return touching, core
 
 
@@ -269,6 +288,28 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
     """
     parts_out = []
     bboxes = arr.bboxes()
+    # one shared candidate pass for all area/line geometries (see
+    # IndexSystem.candidate_cells_batch), plus per-unique-cell boundary/
+    # center cache: neighboring geometries share most candidate cells,
+    # so boundary development is hoisted out of the per-geometry loop
+    is_areal = np.array([arr.geom_type(g) not in
+                         (GeometryType.POINT, GeometryType.MULTIPOINT)
+                         for g in range(len(arr))])
+    cand = [np.empty(0, np.int64)] * len(arr)
+    if is_areal.any():
+        sel = np.nonzero(is_areal)[0]
+        got = grid.candidate_cells_batch(bboxes[sel], res)
+        for g, c in zip(sel, got):
+            cand[g] = c
+    ucells = np.unique(np.concatenate(cand)) if len(arr) else \
+        np.empty(0, np.int64)
+    if len(ucells):
+        uverts, ucounts = grid.cell_boundary(ucells)
+        ucenters = grid.cell_center(ucells)
+
+    poly_types = (GeometryType.POLYGON, GeometryType.MULTIPOLYGON,
+                  GeometryType.GEOMETRYCOLLECTION)
+
     for gi in range(len(arr)):
         t = arr.geom_type(gi)
         if t == GeometryType.POINT or t == GeometryType.MULTIPOINT:
@@ -287,18 +328,15 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
                                      np.zeros(len(cells), bool), b.finish()))
             continue
 
-        bbox = bboxes[gi]
-        if np.any(np.isnan(bbox)):
-            continue
-        cells = grid.candidate_cells(bbox, res)
+        cells = cand[gi]
         if len(cells) == 0:
             continue
-        verts, counts = grid.cell_boundary(cells)
-        centers = grid.cell_center(cells)
+        ci = np.searchsorted(ucells, cells)
+        verts, counts = uverts[ci], ucounts[ci]
+        centers = ucenters[ci]
         edges = _poly_edges(arr, gi)
 
-        if t in (GeometryType.POLYGON, GeometryType.MULTIPOLYGON,
-                 GeometryType.GEOMETRYCOLLECTION):
+        if t in poly_types:
             touching, core = classify_cells(verts, counts, centers, edges)
             core_cells = cells[core]
             border_mask = touching & ~core

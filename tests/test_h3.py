@@ -199,3 +199,23 @@ def test_hex_quantization_bruteforce():
     best = np.argmin(d, axis=1)
     assert np.array_equal(ga, cand[best, 0])
     assert np.array_equal(gb, cand[best, 1])
+
+
+def test_candidate_cells_high_latitude_span():
+    """Latitude-banded sampling: candidate generation must not drop
+    cells on spans reaching high latitude (regression: a single
+    whole-span cos under-sampled low-latitude rows, silently omitting
+    bbox-intersecting cells — wrong PIP joins, unflagged)."""
+    from mosaic_tpu.core.index.factory import get_index_system
+    grid = get_index_system("H3")
+    rng = np.random.default_rng(21)
+    bbs = np.array([[-100.0, lat, -97.0, lat + 4.0]
+                    for lat in range(10, 78, 4)])
+    got = grid.candidate_cells_batch(bbs, 3)
+    for i, b in enumerate(bbs):
+        pts = np.stack([rng.uniform(b[0], b[2], 5000),
+                        rng.uniform(b[1], b[3], 5000)], -1)
+        pc = np.unique(grid.point_to_cell(pts, 3))
+        assert len(np.setdiff1d(pc, got[i])) == 0
+        single = grid.candidate_cells(b, 3)
+        assert len(np.setdiff1d(pc, single)) == 0

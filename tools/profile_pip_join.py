@@ -33,7 +33,9 @@ def main():
     t0 = time.time()
     polys, grid, res = build_workload(n_side=16, grid_name="H3",
                                       zones="taxi")
-    idx = build_pip_index(polys, res, grid)
+    # this tool profiles the SORTED path's stages (chip_a/core_cells/
+    # pip_assign are sorted-only); the dense path is profiled by bench.py
+    idx = build_pip_index(polys, res, grid, dense="never")
     log(f"index build {time.time()-t0:.1f}s; chip_a shape "
         f"{idx.chip_a.shape}, core {idx.core_cells.shape}, "
         f"border {idx.border_cells.shape}, max_dup {idx.max_dup}")
