@@ -1,0 +1,9 @@
+"""Models layer (reference: models/ — SpatialKNN + transformer core)."""
+
+from .checkpoint import CheckpointManager
+from .core import BinaryTransformer, IterationState, IterativeTransformer
+from .knn import SpatialKNN, build_knn_index, knn_host_truth
+
+__all__ = ["BinaryTransformer", "CheckpointManager", "IterationState",
+           "IterativeTransformer", "SpatialKNN", "build_knn_index",
+           "knn_host_truth"]

@@ -1,0 +1,78 @@
+"""Iteration-boundary checkpointing for models.
+
+Reference counterpart: models/util/{CheckpointManager, DeltaFileCheckpoint,
+DeltaTableCheckpoint}.scala — interim KNN matches appended/overwritten as
+Delta files between iterations so a failed job resumes mid-algorithm.
+Here state is numpy arrays; checkpoints are npz files in a directory with
+a monotonic iteration index and an atomic rename commit, so a crash
+mid-write never corrupts the latest good state.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from .core import IterationState
+
+
+class CheckpointManager:
+    """npz-per-iteration checkpoint directory.
+
+    save(state) writes ``iter_{n:04d}.npz`` atomically; load_latest()
+    returns the newest complete state or None.  ``payload`` must be a
+    flat dict of numpy arrays (device arrays are pulled to host —
+    checkpoints are host/storage artifacts by design, reference P7)."""
+
+    def __init__(self, path: str, keep: int = 2):
+        self.path = path
+        self.keep = int(keep)
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, it: int) -> str:
+        return os.path.join(self.path, f"iter_{it:04d}.npz")
+
+    def save(self, state: IterationState) -> str:
+        arrays = {k: np.asarray(v) for k, v in state.payload.items()}
+        arrays["__iteration"] = np.int64(state.iteration)
+        arrays["__converged"] = np.bool_(state.converged)
+        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, self._file(state.iteration))
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self._gc()
+        return self._file(state.iteration)
+
+    def _iterations(self):
+        its = []
+        for name in os.listdir(self.path):
+            if name.startswith("iter_") and name.endswith(".npz"):
+                try:
+                    its.append(int(name[5:-4]))
+                except ValueError:
+                    pass
+        return sorted(its)
+
+    def _gc(self):
+        for it in self._iterations()[:-self.keep]:
+            os.unlink(self._file(it))
+
+    def load_latest(self) -> Optional[IterationState]:
+        its = self._iterations()
+        if not its:
+            return None
+        with np.load(self._file(its[-1])) as z:
+            payload = {k: z[k] for k in z.files
+                       if not k.startswith("__")}
+            return IterationState(
+                iteration=int(z["__iteration"]),
+                payload=payload,
+                converged=bool(z["__converged"]))

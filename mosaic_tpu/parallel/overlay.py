@@ -1,0 +1,366 @@
+"""Distributed polygon x polygon overlay join (P3): both sides sharded.
+
+Reference mechanism: Spark hash-exchanges tessellated chips on cell id
+(expressions/index/MosaicExplode.scala:70-79 feeding an equi-join), so
+neither polygon set needs to fit on one executor.  SURVEY.md P3 names
+the TPU-native equivalent: the equi-join becomes a cell-id-bucketed
+all-to-all over ICI.
+
+Pipeline (shard_map over the mesh's data axis):
+
+  1. each device holds an arbitrary row-block of A-chips and B-chips
+     (ingest placement);
+  2. rows route to device hash(cell) % D via ONE jax.lax.all_to_all
+     (fixed-capacity buckets: static shapes; overflow is counted and
+     surfaced, never silently dropped);
+  3. the local join is the sorted-table probe from the PIP join — sort
+     local A rows by cell, binary-search each B row, probe duplicates;
+  4. chip-pair ST_Intersects runs as dense f32 edge tests (segment
+     crossings + representative-vertex containment);
+  5. per-pair hits psum into a replicated [GA, GB] boolean matrix.
+
+Exactness contract (same shape as pip_join): f32 hazards — near-touching
+edges within EPS of crossing, or representative vertices within EPS of a
+boundary — flag the pair; flagged pairs re-run on host in f64 against
+the ORIGINAL geometries (overlay_host_pair).  ST_Intersects of two
+polygons that merely share a tessellation cell but do not touch is
+False, so the cell co-location is only the candidate filter, exactly as
+in the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core.geometry.array import GeometryArray
+from ..core.index.base import IndexSystem
+from ..core.tessellate import tessellate
+from ..types import ChipSet
+
+EPS_DEG = 1e-6
+
+
+# ----------------------------------------------------------- host packing
+
+def pack_chip_rows(polys: GeometryArray, res: int, grid: IndexSystem,
+                   chips: Optional[ChipSet] = None,
+                   origin: Optional[np.ndarray] = None,
+                   edge_cap: Optional[int] = None):
+    """ChipSet -> dense device rows (cell i64, geom i32, edges [E, 4]
+    f32 local, valid bool).
+
+    Core chips carry the full cell boundary as their edge soup?  No —
+    core cells are *fully covered* by their polygon, so for overlay
+    purposes a core chip is the cell itself; tessellate(keep_core_geom
+    =True) already emits the cell polygon for core chips."""
+    if chips is None:
+        chips = tessellate(polys, res, grid, keep_core_geom=True)
+    from ..core.geometry.padded import build_edges_np
+    A, B, M = build_edges_np(chips.geoms)
+    if origin is None:
+        bb = polys.bboxes()
+        origin = np.round(np.array(
+            [np.nanmean(bb[:, [0, 2]]), np.nanmean(bb[:, [1, 3]])]), 1)
+    cap = edge_cap or A.shape[1]
+    n, e = A.shape[:2]
+    edges = np.full((n, cap, 4), 1e9, np.float32)
+    e = min(e, cap)
+    edges[:, :e, 0] = (A[:, :e, 0] - origin[0]).astype(np.float32)
+    edges[:, :e, 1] = (A[:, :e, 1] - origin[1]).astype(np.float32)
+    edges[:, :e, 2] = (B[:, :e, 0] - origin[0]).astype(np.float32)
+    edges[:, :e, 3] = (B[:, :e, 1] - origin[1]).astype(np.float32)
+    edges[~np.broadcast_to(M[:, :cap, None], edges.shape)] = 1e9
+    valid = M[:, :cap].any(axis=1)
+    assert M[:, cap:].sum() == 0, "edge_cap clipped real edges"
+    return (chips.cell_id.astype(np.int64),
+            chips.geom_id.astype(np.int32), edges, valid, origin, chips)
+
+
+def _pad_rows(cell, geom, edges, valid, rows_per_dev: int, n_dev: int):
+    """Round-robin row-block placement padded to [n_dev*rows_per_dev]."""
+    n = len(cell)
+    total = rows_per_dev * n_dev
+    assert n <= total, (n, total)
+    pad = total - n
+    cell = np.concatenate([cell, np.full(pad, -1, np.int64)])
+    geom = np.concatenate([geom, np.full(pad, -1, np.int32)])
+    edges = np.concatenate(
+        [edges, np.full((pad, *edges.shape[1:]), 1e9, np.float32)])
+    valid = np.concatenate([valid, np.zeros(pad, bool)])
+    return cell, geom, edges, valid
+
+
+# ----------------------------------------------------------- device logic
+
+def _hash_dest(cell, n_dev: int):
+    """Cheap int64 mix -> device index (valid rows only)."""
+    import jax.numpy as jnp
+    mix = np.uint64(0x9E3779B97F4A7C15).astype(np.int64)  # wraps signed
+    h = cell * jnp.int64(mix)
+    h = h ^ (h >> 29)
+    return (h % n_dev + n_dev).astype(jnp.int32) % n_dev
+
+
+def _chip_pair_test(ea, eb):
+    """f32 intersects + hazard flag for one chip pair.
+
+    ea, eb [E, 4] (ax, ay, bx, by; 1e9 sentinel padding).  Returns
+    (hit, hazard).  hit = any proper segment crossing, or a
+    representative vertex of one inside the other (if no edges cross,
+    the chips are disjoint or nested — one containment test each way
+    decides).  hazard = any orientation test or containment crossing
+    within EPS of zero."""
+    import jax.numpy as jnp
+
+    a1 = ea[:, None, 0:2]
+    b1 = ea[:, None, 2:4]
+    a2 = eb[None, :, 0:2]
+    b2 = eb[None, :, 2:4]
+
+    def orient(p, q, r):
+        return (q[..., 0] - p[..., 0]) * (r[..., 1] - p[..., 1]) - \
+               (q[..., 1] - p[..., 1]) * (r[..., 0] - p[..., 0])
+
+    d1 = orient(a2, b2, a1)
+    d2 = orient(a2, b2, b1)
+    d3 = orient(a1, b1, a2)
+    d4 = orient(a1, b1, b2)
+    pad = (jnp.abs(ea[:, None, 0]) > 1e8) | \
+        (jnp.abs(eb[None, :, 0]) > 1e8)
+    proper = ((d1 > 0) != (d2 > 0)) & ((d3 > 0) != (d4 > 0)) & ~pad
+    # scale-aware degeneracy band: |orient| ~ len1*len2*sin(angle);
+    # normalize by segment length products
+    l1 = jnp.linalg.norm(b1 - a1, axis=-1)
+    l2 = jnp.linalg.norm(b2 - a2, axis=-1)
+    scale = jnp.maximum(l1 * l2, 1e-30)
+    tiny = (jnp.minimum(jnp.minimum(jnp.abs(d1), jnp.abs(d2)),
+                        jnp.minimum(jnp.abs(d3), jnp.abs(d4))) / scale
+            < EPS_DEG) & ~pad
+    crossing = jnp.any(proper)
+
+    def contains(point, e):
+        px, py = point[0], point[1]
+        ax, ay, bx, by = e[:, 0], e[:, 1], e[:, 2], e[:, 3]
+        epad = jnp.abs(ax) > 1e8
+        straddle = ((ay <= py) != (by <= py)) & ~epad
+        t = (py - ay) / jnp.where(by == ay, 1.0, by - ay)
+        xi = ax + t * (bx - ax)
+        hits = straddle & (px < xi)
+        inside = (jnp.sum(hits) & 1).astype(bool)
+        near = jnp.any(straddle & (jnp.abs(px - xi) < EPS_DEG)) | \
+            jnp.any((jnp.abs(py - ay) < EPS_DEG) & ~epad &
+                    (px < jnp.maximum(ax, bx) + EPS_DEG))
+        return inside, near
+
+    ina, na = contains(ea[0, 0:2], eb)
+    inb, nb = contains(eb[0, 0:2], ea)
+    hit = crossing | ina | inb
+    hazard = jnp.any(tiny) | na | nb
+    return hit, hazard
+
+
+def _local_sorted_join(cell_a, geom_a, edges_a, valid_a,
+                       cell_b, geom_b, edges_b, valid_b,
+                       ga: int, gb: int, dup_cap: int):
+    """Sorted-table probe join of local rows; returns (hits [ga, gb]
+    i32, hazards [ga, gb] i32, max_dup_needed)."""
+    import jax
+    import jax.numpy as jnp
+
+    big = jnp.int64(0x7FFFFFFFFFFFFFFF)
+    key_a = jnp.where(valid_a, cell_a, big)
+    order = jnp.argsort(key_a)
+    key_a = key_a[order]
+    geom_a = geom_a[order]
+    edges_a = edges_a[order]
+
+    start = jnp.searchsorted(key_a, jnp.where(valid_b, cell_b, -big))
+    upper = jnp.searchsorted(key_a, jnp.where(valid_b, cell_b, -big),
+                             side="right")
+    dup_needed = jnp.max(jnp.where(valid_b, upper - start, 0))
+
+    hits = jnp.zeros((ga, gb), jnp.int32)
+    hazards = jnp.zeros((ga, gb), jnp.int32)
+    pair_fn = jax.vmap(_chip_pair_test)
+    na = key_a.shape[0]
+    for j in range(dup_cap):
+        s = jnp.clip(start + j, 0, max(na - 1, 0))
+        match = valid_b & (start + j < upper)
+        h, hz = pair_fn(edges_a[s], edges_b)
+        ga_i = jnp.where(match, geom_a[s], 0)
+        gb_i = jnp.where(match, geom_b, 0)
+        add_h = (h & match).astype(jnp.int32)
+        add_z = (hz & match).astype(jnp.int32)
+        hits = hits.at[ga_i, gb_i].max(add_h, mode="drop")
+        hazards = hazards.at[ga_i, gb_i].max(add_z, mode="drop")
+    return hits, hazards, dup_needed
+
+
+def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
+                    mesh=None, axis: str = "data",
+                    bucket_cap: int = 0, dup_cap: int = 8):
+    """Build the (optionally sharded) overlay ST_Intersects kernel.
+
+    Returns fn(cell_a, geom_a, edges_a, valid_a, cell_b, ...) ->
+    (hits [ga, gb] i32, hazards [ga, gb] i32, diag [3] i32 =
+    (overflow_a, overflow_b, dup_needed)).  Without a mesh it is the
+    single-device join (no exchange); with a mesh, rows all_to_all to
+    hash(cell) % D first."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        def fn(ca, gea, ea, va, cb, geb, eb, vb):
+            h, z, dn = _local_sorted_join(ca, gea, ea, va, cb, geb, eb,
+                                          vb, ga, gb, dup_cap)
+            return h, z, jnp.stack([jnp.int32(0), jnp.int32(0),
+                                    dn.astype(jnp.int32)])
+        return jax.jit(fn)
+
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    D = mesh.shape[axis]
+    assert bucket_cap > 0, "sharded overlay needs a bucket capacity"
+
+    def exchange(cell, geom, edges, valid, cap_e):
+        # route rows to hash(cell) % D with fixed-capacity buckets
+        dest = jnp.where(valid, _hash_dest(cell, D), D)  # invalid -> D
+        order = jnp.argsort(dest)
+        dest_s = dest[order]
+        pos = jnp.arange(dest.shape[0], dtype=jnp.int32) - \
+            jnp.searchsorted(dest_s, dest_s).astype(jnp.int32)
+        overflow = jnp.sum((pos >= bucket_cap) & (dest_s < D))
+        okrow = (dest_s < D) & (pos < bucket_cap)
+        # bad rows route to device index D: out of bounds, so the
+        # mode="drop" scatters discard them instead of clobbering the
+        # last in-bounds slot
+        d_i = jnp.where(okrow, dest_s, D)
+        p_i = jnp.where(okrow, pos, 0)
+        sc = jnp.full((D, bucket_cap), jnp.int64(-1))
+        sg = jnp.full((D, bucket_cap), jnp.int32(-1))
+        se = jnp.full((D, bucket_cap, cap_e, 4), jnp.float32(1e9))
+        sv = jnp.zeros((D, bucket_cap), bool)
+        sc = sc.at[d_i, p_i].set(jnp.where(okrow, cell[order], -1),
+                                 mode="drop")
+        sg = sg.at[d_i, p_i].set(jnp.where(okrow, geom[order], -1),
+                                 mode="drop")
+        se = se.at[d_i, p_i].set(jnp.where(okrow[:, None, None],
+                                           edges[order], 1e9),
+                                 mode="drop")
+        sv = sv.at[d_i, p_i].set(okrow & valid[order], mode="drop")
+        rc = jax.lax.all_to_all(sc, axis, 0, 0)
+        rg = jax.lax.all_to_all(sg, axis, 0, 0)
+        re = jax.lax.all_to_all(se, axis, 0, 0)
+        rv = jax.lax.all_to_all(sv, axis, 0, 0)
+        flat = lambda x: x.reshape((D * bucket_cap,) + x.shape[2:])
+        return flat(rc), flat(rg), flat(re), flat(rv), overflow
+
+    def local(ca, gea, ea, va, cb, geb, eb, vb):
+        ca, gea, ea, va, ofa = exchange(ca, gea, ea, va, edge_cap_a)
+        cb, geb, eb, vb, ofb = exchange(cb, geb, eb, vb, edge_cap_b)
+        h, z, dn = _local_sorted_join(ca, gea, ea, va, cb, geb, eb, vb,
+                                      ga, gb, dup_cap)
+        diag = jnp.stack([ofa.astype(jnp.int32), ofb.astype(jnp.int32),
+                          dn.astype(jnp.int32)])
+        return (jax.lax.psum(h, axis), jax.lax.psum(z, axis),
+                jax.lax.pmax(diag, axis))
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P()))
+    return jax.jit(fn)
+
+
+# ------------------------------------------------------------ host oracle
+
+def overlay_host_pair(polys_a: GeometryArray, polys_b: GeometryArray,
+                      ia: int, ib: int) -> bool:
+    """Exact f64 ST_Intersects of one polygon pair (edge crossings +
+    mutual containment via crossing number)."""
+    from ..core.tessellate import _pip, _poly_edges, _seg_cross
+    ea = _poly_edges(polys_a, ia)
+    eb = _poly_edges(polys_b, ib)
+    if len(ea) == 0 or len(eb) == 0:
+        return False
+    if np.any(_seg_cross(ea[:, None, 0], ea[:, None, 1],
+                         eb[None, :, 0], eb[None, :, 1])):
+        return True
+    return bool(_pip(ea[:1, 0], eb)[0] or _pip(eb[:1, 0], ea)[0])
+
+
+def overlay_host_truth(polys_a: GeometryArray,
+                       polys_b: GeometryArray) -> np.ndarray:
+    """[GA, GB] exact boolean intersects matrix (bbox-pruned)."""
+    ba = polys_a.bboxes()
+    bb = polys_b.bboxes()
+    out = np.zeros((len(polys_a), len(polys_b)), bool)
+    for i in range(len(polys_a)):
+        cand = np.nonzero((ba[i, 0] <= bb[:, 2]) & (bb[:, 0] <= ba[i, 2])
+                          & (ba[i, 1] <= bb[:, 3]) &
+                          (bb[:, 1] <= ba[i, 3]))[0]
+        for j in cand:
+            out[i, j] = overlay_host_pair(polys_a, polys_b, i, int(j))
+    return out
+
+
+# -------------------------------------------------------------- end2end
+
+def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
+                       res: int, grid: IndexSystem, mesh=None,
+                       axis: str = "data") -> np.ndarray:
+    """Distributed exact ST_Intersects overlay: [GA, GB] bool.
+
+    Tessellates both sides, runs the (sharded) chip join, then resolves
+    f32-hazard pairs on host in f64.  This is the BASELINE config 3
+    (building footprints x flood zones) engine."""
+    import jax.numpy as jnp
+
+    rows_a = pack_chip_rows(polys_a, res, grid)
+    origin = rows_a[4]
+    rows_b = pack_chip_rows(polys_b, res, grid, origin=origin)
+    ca, gea, ea, va = rows_a[:4]
+    cb, geb, eb, vb = rows_b[:4]
+    ga, gb = len(polys_a), len(polys_b)
+
+    dup_cap = 8
+    if mesh is not None:
+        D = mesh.shape[axis]
+        rpa = -(-len(ca) // D)
+        rpb = -(-len(cb) // D)
+        ca, gea, ea, va = _pad_rows(ca, gea, ea, va, rpa, D)
+        cb, geb, eb, vb = _pad_rows(cb, geb, eb, vb, rpb, D)
+        bucket_cap = max(64, 2 * max(rpa, rpb))
+    args = tuple(jnp.asarray(v) for v in
+                 (ca, gea, ea, va, cb, geb, eb, vb))
+    # retry loops: bucket/dup capacities are static shapes, so a skewed
+    # hash or a crowded cell grows them and re-runs instead of failing
+    # (overflow is always detected, never silent)
+    while True:
+        if mesh is None:
+            fn = make_overlay_fn(ga, gb, ea.shape[1], eb.shape[1],
+                                 dup_cap=dup_cap)
+        else:
+            fn = make_overlay_fn(ga, gb, ea.shape[1], eb.shape[1],
+                                 mesh=mesh, axis=axis,
+                                 bucket_cap=bucket_cap, dup_cap=dup_cap)
+        h, z, diag = fn(*args)
+        diag = np.asarray(diag)
+        if mesh is not None and (diag[0] > 0 or diag[1] > 0):
+            bucket_cap *= 2
+            continue
+        if diag[2] > dup_cap:
+            dup_cap = int(2 ** np.ceil(np.log2(max(diag[2], 2))))
+            continue
+        break
+
+    hits = np.asarray(h) > 0
+    hz = np.asarray(z) > 0
+    # f64 resolution of flagged pairs against the ORIGINAL geometries
+    for i, j in zip(*np.nonzero(hz)):
+        hits[i, j] = overlay_host_pair(polys_a, polys_b, int(i), int(j))
+    return hits
