@@ -300,12 +300,36 @@ class RasterFunctions:
                    ) -> List[RasterTile]:
         return [rops.filter_tile(t, size, op) for t in tiles]
 
-    def rst_transform(self, tiles: Tiles, srid: int) -> List[RasterTile]:
-        """reference: RST_Transform (CRS warp).  Implemented for the
-        pure-math CRS pairs supported by st_transform."""
-        raise NotImplementedError(
-            "raster CRS warp lands with the CRS transform layer "
-            "(st_transform); GTiff tiles carry srid metadata until then")
+    def rst_transform(self, tiles: Tiles, srid: int,
+                      method: str = "bilinear") -> List[RasterTile]:
+        """reference: RST_Transform
+        (core/raster/operator/proj/RasterProject.scala:45) — CRS warp by
+        inverse-mapped resampling for the pure-math CRS pairs supported
+        by st_transform (4326, 3857, 27700, UTM)."""
+        return [rops.warp(t, srid, method=method) for t in tiles]
+
+    def rst_dtmfromgeoms(self, points_xyz, gt, width: int, height: int,
+                         constraints=None) -> RasterTile:
+        """reference: RST_DTMFromGeoms
+        (expressions/raster/RST_DTMFromGeoms.scala) — Delaunay TIN of
+        elevation points rasterized to a grid by barycentric z."""
+        from ..core.raster.tile import GeoTransform
+        if not isinstance(gt, GeoTransform):
+            gt = GeoTransform.from_tuple(gt)
+        return rops.dtm_from_geoms(points_xyz, gt, width, height,
+                                   constraints=constraints)
+
+    def rst_rasterize(self, geoms, values, gt, width: int, height: int,
+                      fill: float = float("nan"),
+                      all_touched: bool = False) -> RasterTile:
+        """Burn geometries into a raster (reference:
+        rasterize/GDALRasterize.scala:155; the engine under
+        RST_DTMFromGeoms and vector->raster conversions)."""
+        from ..core.raster.tile import GeoTransform
+        if not isinstance(gt, GeoTransform):
+            gt = GeoTransform.from_tuple(gt)
+        return rops.rasterize(geoms, values, gt, width, height,
+                              fill=fill, all_touched=all_touched)
 
     def rst_separatebands(self, tiles: Tiles) -> List[RasterTile]:
         out = []

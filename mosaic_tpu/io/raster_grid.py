@@ -56,6 +56,10 @@ def raster_to_grid(tiles: Sequence[RasterTile], res: int,
     """
     per_cell: Dict[int, List[RasterTile]] = {}
     for t in tiles:
+        if t.srid != grid.crs_id:
+            # reference projects every tile into the index CRS before
+            # clipping (retile/RasterTessellate.scala:34 via RasterProject)
+            t = rops.warp(t, grid.crs_id)
         for ct in rops.tessellate_raster(t, res, grid):
             per_cell.setdefault(int(ct.cell_id), []).append(ct)
 

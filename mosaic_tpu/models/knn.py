@@ -318,7 +318,9 @@ class SpatialKNN(IterativeTransformer):
         # max_iterations before the separation floor covered their kth
         # distance
         bad_face = face != idx.face0
-        sep_f = self._sep_floor(d)
+        # the driver bumps iteration after the last step, so rings
+        # 0..d-1 were scanned; the floor must use the LAST ring
+        sep_f = self._sep_floor(d - 1)
         unconverged = ~(top_d2[:, k - 1] <= np.float32(sep_f) ** 2)
         if self.distance_threshold is not None:
             unconverged &= ~(sep_f >= self.distance_threshold)
@@ -363,7 +365,7 @@ class SpatialKNN(IterativeTransformer):
             "right_id": rid,
             "distance": dist,
             "rank": np.broadcast_to(np.arange(k), (n, k)).copy(),
-            "iterations": d + 1,
+            "iterations": d,
             "rechecked": int(flagged.sum()),
         }
 

@@ -182,11 +182,14 @@ def _local_sorted_join(cell_a, geom_a, edges_a, valid_a,
                              side="right")
     dup_needed = jnp.max(jnp.where(valid_b, upper - start, 0))
 
-    hits = jnp.zeros((ga, gb), jnp.int32)
-    hazards = jnp.zeros((ga, gb), jnp.int32)
     pair_fn = jax.vmap(_chip_pair_test)
     na = key_a.shape[0]
-    for j in range(dup_cap):
+
+    # duplicate probe as a fori_loop: program size stays constant when
+    # crowded cells force dup_cap up (an unrolled python loop re-traced
+    # thousands of pair-test vmaps at dup_cap retries)
+    def body(j, carry):
+        hits, hazards = carry
         s = jnp.clip(start + j, 0, max(na - 1, 0))
         match = valid_b & (start + j < upper)
         h, hz = pair_fn(edges_a[s], edges_b)
@@ -196,6 +199,13 @@ def _local_sorted_join(cell_a, geom_a, edges_a, valid_a,
         add_z = (hz & match).astype(jnp.int32)
         hits = hits.at[ga_i, gb_i].max(add_h, mode="drop")
         hazards = hazards.at[ga_i, gb_i].max(add_z, mode="drop")
+        return hits, hazards
+
+    # under shard_map the carry must already be device-varying (the loop
+    # body's scatters are), so seed it with a varying zero
+    zero = (cell_b[:1].astype(jnp.int32) * 0).reshape(())
+    init = jnp.zeros((ga, gb), jnp.int32) + zero
+    hits, hazards = jax.lax.fori_loop(0, dup_cap, body, (init, init))
     return hits, hazards, dup_needed
 
 

@@ -1,0 +1,134 @@
+"""Shapefile datasource (io/shapefile.py).
+
+Reference test shape: the OGR/shapefile reader suites load small
+fixtures and check schema + geometry round trips
+(datasource/ShapefileFileFormatTest etc.).  With zero egress there is
+no canned fixture; the writer produces the fixture and the reader is
+validated against the source geometries — plus the VERDICT round-3
+criterion: read -> tessellate -> join parity vs the WKT-loaded
+equivalent.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.bench.workloads import nyc_zones
+from mosaic_tpu.core.geometry.wkt import read_wkt, write_wkt
+from mosaic_tpu.core.index.factory import get_index_system
+from mosaic_tpu.io.shapefile import (read_shapefile, read_vector,
+                                     write_shapefile)
+
+
+@pytest.fixture
+def zones():
+    return nyc_zones(n_side=3, seed=8)
+
+
+def test_shapefile_round_trip_polygons(tmp_path, zones):
+    p = str(tmp_path / "zones.shp")
+    cols = {"zone_id": list(range(len(zones))),
+            "name": [f"z{i}" for i in range(len(zones))],
+            "score": [i * 1.5 for i in range(len(zones))]}
+    write_shapefile(p, zones, cols)
+    geoms, attrs = read_shapefile(p)
+    assert len(geoms) == len(zones)
+    assert attrs["zone_id"] == cols["zone_id"]
+    assert attrs["name"] == cols["name"]
+    assert np.allclose(attrs["score"], cols["score"])
+    # geometry round trip via WKT text equality is too strict (ring
+    # winding may flip); compare canonical signed areas + vertex sets
+    from mosaic_tpu.core.geometry.clip import (geometry_rings,
+                                               ring_signed_area)
+    for i in range(len(zones)):
+        a = sum(abs(ring_signed_area(r))
+                for r in geometry_rings(zones, i))
+        b = sum(abs(ring_signed_area(r))
+                for r in geometry_rings(geoms, i))
+        assert a == pytest.approx(b, rel=1e-12)
+
+
+def test_shapefile_polygon_with_hole(tmp_path):
+    wkt = ["POLYGON((0 0, 10 0, 10 10, 0 10, 0 0), "
+           "(3 3, 7 3, 7 7, 3 7, 3 3))"]
+    src = read_wkt(wkt)
+    p = str(tmp_path / "hole.shp")
+    write_shapefile(p, src)
+    geoms, _ = read_shapefile(p)
+    from mosaic_tpu.core.geometry.clip import (geometry_rings,
+                                               ring_signed_area)
+    rings = geometry_rings(geoms, 0)
+    assert len(rings) == 2
+    total = sum(ring_signed_area(r) for r in rings)
+    assert total == pytest.approx(100 - 16)
+
+
+def test_shapefile_points_and_lines(tmp_path):
+    pts = read_wkt(["POINT(1 2)", "POINT(-3 4.5)"])
+    p = str(tmp_path / "pts.shp")
+    write_shapefile(p, pts)
+    geoms, _ = read_shapefile(p)
+    assert np.allclose(geoms.coords[:, :2], pts.coords[:, :2])
+
+    lines = read_wkt(["LINESTRING(0 0, 1 1, 2 0)"])
+    p2 = str(tmp_path / "lines.shp")
+    write_shapefile(p2, lines)
+    geoms2, _ = read_shapefile(p2)
+    assert np.allclose(geoms2.coords[:, :2], lines.coords[:, :2])
+
+
+def test_shapefile_join_parity_vs_wkt(tmp_path, zones):
+    """VERDICT round-3 criterion: shapefile -> tessellate -> PIP join
+    equals the WKT-loaded path exactly."""
+    import jax
+    import jax.numpy as jnp
+    from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                              host_recheck_fn, localize,
+                                              make_pip_join_fn)
+    p = str(tmp_path / "zones.shp")
+    write_shapefile(p, zones)
+    from_shp, _ = read_shapefile(p)
+    from_wkt = read_wkt(write_wkt(zones))
+    grid = get_index_system("H3")
+    rng = np.random.default_rng(12)
+    pts = np.stack([rng.uniform(-74.25, -73.70, 20_000),
+                    rng.uniform(40.50, 40.90, 20_000)], -1)
+    outs = []
+    for polys in (from_shp, from_wkt):
+        idx = build_pip_index(polys, 9, grid)
+        fn = jax.jit(make_pip_join_fn(idx, grid))
+        z, u = fn(jnp.asarray(localize(idx, pts)))
+        outs.append(host_recheck_fn(idx)(pts, np.asarray(z),
+                                         np.asarray(u)))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_read_vector_driver_dispatch(tmp_path, zones):
+    p = str(tmp_path / "zones.shp")
+    write_shapefile(p, zones)
+    g1, _ = read_vector(p)
+    assert len(g1) == len(zones)
+    # wkt driver
+    wp = tmp_path / "zones.wkt"
+    wp.write_text("\n".join(write_wkt(zones)))
+    g2, _ = read_vector(str(wp))
+    assert len(g2) == len(zones)
+    # geojson FeatureCollection
+    import json
+    from mosaic_tpu.core.geometry.geojson import write_geojson
+    feats = [{"type": "Feature", "geometry": json.loads(j),
+              "properties": {"i": i}}
+             for i, j in enumerate(write_geojson(zones))]
+    jp = tmp_path / "zones.geojson"
+    jp.write_text(json.dumps({"type": "FeatureCollection",
+                              "features": feats}))
+    g3, cols = read_vector(str(jp))
+    assert len(g3) == len(zones) and cols["i"] == list(range(len(zones)))
+    with pytest.raises(ValueError):
+        read_vector("nope.xyz")
+
+
+def test_shapefile_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.shp"
+    p.write_bytes(b"not a shapefile at all")
+    with pytest.raises(ValueError):
+        read_shapefile(str(p))
