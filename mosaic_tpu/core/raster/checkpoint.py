@@ -1,0 +1,107 @@
+"""Raster tile serialization: bytes through the wire, or checkpoint paths.
+
+Reference counterparts: core/types/RasterTileType.scala:31-37 (the tile
+struct's raster field switches BinaryType <-> StringType path depending
+on checkpointing) and gdal/MosaicGDAL.scala:135-234 (driver-side
+checkpoint dir management: enable/disable, set path, update).  The conf
+keys in config.py carried this switch since round 1; this module makes
+them real: with ``raster_use_checkpoint`` on, serialized tiles spill
+GeoTIFF files into ``raster_checkpoint`` (content-hashed names, atomic
+rename) and the wire record carries only the path.
+
+The wire record is a plain dict — the columnar analogue of the
+reference's InternalRow(index_id, raster, metadata).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from ... import config as _config
+from .gtiff import read_gtiff, write_gtiff
+from .tile import RasterTile
+
+__all__ = ["serialize_tile", "deserialize_tile", "enable_checkpoint",
+           "disable_checkpoint", "set_checkpoint_dir", "checkpoint_dir",
+           "is_checkpoint_enabled"]
+
+
+# ------------------------------------------------- management (driver side)
+
+def enable_checkpoint(path: Optional[str] = None) -> None:
+    """Turn path-mode serialization on (reference:
+    MosaicGDAL.enableGDALWithCheckpoint)."""
+    cfg = _config.default_config()
+    import dataclasses
+    _config.set_default_config(dataclasses.replace(
+        cfg, raster_use_checkpoint=True,
+        raster_checkpoint=path or cfg.raster_checkpoint))
+
+
+def disable_checkpoint() -> None:
+    import dataclasses
+    _config.set_default_config(dataclasses.replace(
+        _config.default_config(), raster_use_checkpoint=False))
+
+
+def set_checkpoint_dir(path: str) -> None:
+    import dataclasses
+    _config.set_default_config(dataclasses.replace(
+        _config.default_config(), raster_checkpoint=path))
+
+
+def checkpoint_dir() -> str:
+    return _config.default_config().raster_checkpoint
+
+
+def is_checkpoint_enabled() -> bool:
+    return _config.default_config().raster_use_checkpoint
+
+
+# ------------------------------------------------------------ wire format
+
+def serialize_tile(tile: RasterTile,
+                   cfg: Optional[_config.MosaicConfig] = None) -> dict:
+    """RasterTile -> wire record {cell_id, raster, metadata}.
+
+    raster is GeoTIFF bytes, or (checkpoint mode) a path to a GeoTIFF
+    written under the checkpoint dir — content-hashed name, atomic
+    rename, so concurrent writers of the same tile are idempotent and a
+    crash never leaves a partial file behind a valid name."""
+    cfg = cfg or _config.default_config()
+    payload = write_gtiff(tile)
+    meta = dict(tile.meta)
+    if not cfg.raster_use_checkpoint:
+        return {"cell_id": tile.cell_id, "raster": payload,
+                "metadata": meta}
+    os.makedirs(cfg.raster_checkpoint, exist_ok=True)
+    name = hashlib.sha256(payload).hexdigest()[:24] + ".tif"
+    path = os.path.join(cfg.raster_checkpoint, name)
+    if not os.path.exists(path):
+        fd, tmp = tempfile.mkstemp(dir=cfg.raster_checkpoint,
+                                   suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    meta["checkpoint_path"] = path
+    return {"cell_id": tile.cell_id, "raster": path, "metadata": meta}
+
+
+def deserialize_tile(rec: dict) -> RasterTile:
+    """Wire record -> RasterTile (reads back through the codec either
+    way, so both modes exercise the same decode path)."""
+    raster = rec["raster"]
+    if isinstance(raster, (bytes, bytearray)):
+        tile = read_gtiff(bytes(raster))
+    else:
+        with open(raster, "rb") as f:
+            tile = read_gtiff(f.read())
+    import dataclasses
+    return dataclasses.replace(
+        tile, cell_id=rec.get("cell_id"),
+        meta=dict(tile.meta, **rec.get("metadata", {})))

@@ -219,3 +219,22 @@ def test_candidate_cells_high_latitude_span():
         assert len(np.setdiff1d(pc, got[i])) == 0
         single = grid.candidate_cells(b, 3)
         assert len(np.setdiff1d(pc, single)) == 0
+
+
+def test_candidate_cells_stream_large_extent():
+    """Streaming candidates for extents beyond the in-memory bound:
+    batches are disjoint, bounded, and their union covers every cell a
+    direct (small-extent) query finds."""
+    from mosaic_tpu.core.index.factory import get_index_system
+    grid = get_index_system("H3")
+    bbox = np.array([-80.0, 30.0, -70.0, 42.0])
+    res = 5
+    seen = []
+    for batch in grid.candidate_cells_stream(bbox, res,
+                                             batch_cells=2000):
+        assert len(batch) <= 4 * 2000 + 16
+        seen.append(batch)
+    allc = np.concatenate(seen)
+    assert len(allc) == len(np.unique(allc)), "stream emitted dupes"
+    direct = grid.candidate_cells(bbox, res)
+    assert len(np.setdiff1d(direct, allc)) == 0
