@@ -55,8 +55,9 @@ class H3IndexSystem(IndexSystem):
         # self-consistent.  Silence with MOSAIC_TPU_SUPPRESS_H3_INTEROP=1.
         global _INTEROP_WARNED
         import os
-        if not _INTEROP_WARNED and not os.environ.get(
-                "MOSAIC_TPU_SUPPRESS_H3_INTEROP"):
+        if not _INTEROP_WARNED and os.environ.get(
+                "MOSAIC_TPU_SUPPRESS_H3_INTEROP", "").lower() not in (
+                "1", "true", "yes"):
             import warnings
             warnings.warn(
                 "mosaic_tpu H3 cell ids use a self-assigned base-cell "
@@ -202,36 +203,48 @@ class H3IndexSystem(IndexSystem):
         """Streaming candidate generation for extents beyond the
         in-memory max_cells bound (VERDICT round-2 item 10: a
         continent-scale polygon at res 9 must degrade to streaming, not
-        die).  Yields deduplicated int64 cell batches by sweeping the
-        bbox in latitude strips; a cell straddling a strip boundary is
-        emitted by the first strip that samples it.
+        die).  Yields disjoint int64 cell batches.
 
-        The reference's analogue is BNG's BFS polyfill
-        (BNGIndexSystem.scala:185-219) — a strip sweep gives the same
-        bounded-memory property for a convex bbox without frontier
-        bookkeeping."""
+        The padded bbox is tiled into sub-boxes sized to ~batch_cells
+        cells in BOTH axes (a latitude-strip-only sweep still blows the
+        per-batch bound once the width alone exceeds it); each sub-box
+        emits exactly the cells whose center it owns (half-open, closed
+        on the region's max edges), so no cross-batch dedup state is
+        needed and memory stays bounded for any extent."""
         self._check_res(res)
         inr, circ = self._cell_metrics_deg(res)
-        x0 = float(bbox[0]) - circ
-        x1 = float(bbox[2]) + circ
-        y0 = max(float(bbox[1]) - circ, -90.0)
-        y1 = min(float(bbox[3]) + circ, 90.0)
-        # strip height sized so one strip stays under batch_cells
-        width_cells = max((x1 - x0) / (2 * inr), 1.0)
-        strip_h = max(batch_cells / width_cells, 4.0) * inr
-        prev_tail = np.empty(0, np.int64)
-        y = y0
-        while y < y1:
-            yt = min(y + strip_h, y1)
-            cells = self.candidate_cells(
-                np.array([x0, y, x1, yt]),
-                res, max_cells=4 * batch_cells + 16)
-            fresh = np.setdiff1d(cells, prev_tail, assume_unique=False)
-            if len(fresh):
-                yield fresh
-            # cells near the seam get re-sampled by the next strip
-            prev_tail = cells
-            y = yt
+        # 2x circ: the non-streaming path's sampled cells can have
+        # centers up to 2 circumradii outside the bbox (circ of bbox
+        # padding + circ of sample-to-center); the ownership region must
+        # cover them so the stream is a superset of the direct query
+        x0 = float(bbox[0]) - 2 * circ
+        x1 = float(bbox[2]) + 2 * circ
+        y0 = max(float(bbox[1]) - 2 * circ, -90.0)
+        y1 = min(float(bbox[3]) + 2 * circ, 90.0)
+        side_cells = max(np.sqrt(batch_cells) / 2.0, 2.0)
+        step = side_cells * 2.0 * inr
+        ny = max(int(np.ceil((y1 - y0) / step)), 1)
+        nx = max(int(np.ceil((x1 - x0) / step)), 1)
+        for iy in range(ny):
+            by0 = y0 + iy * step
+            by1 = min(by0 + step, y1)
+            for ix in range(nx):
+                bx0 = x0 + ix * step
+                bx1 = min(bx0 + step, x1)
+                cells = self.candidate_cells(
+                    np.array([bx0, by0, bx1, by1]), res,
+                    max_cells=8 * batch_cells + 64)
+                if not len(cells):
+                    continue
+                c = self.cell_center(cells)
+                # edge boxes also claim centers beyond the region
+                # rim so no sampled cell is orphaned by a tie
+                own = ((c[:, 0] >= bx0) | (ix == 0)) & \
+                    ((c[:, 1] >= by0) | (iy == 0)) & \
+                    ((c[:, 0] < bx1) | (ix == nx - 1)) & \
+                    ((c[:, 1] < by1) | (iy == ny - 1))
+                if own.any():
+                    yield cells[own]
 
     def candidate_cells_batch(self, bboxes: np.ndarray, res: int,
                               max_cells: int = 4_000_000) -> list:

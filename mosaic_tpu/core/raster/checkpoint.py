@@ -15,6 +15,7 @@ reference's InternalRow(index_id, raster, metadata).
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import os
 import tempfile
@@ -37,20 +38,17 @@ def enable_checkpoint(path: Optional[str] = None) -> None:
     """Turn path-mode serialization on (reference:
     MosaicGDAL.enableGDALWithCheckpoint)."""
     cfg = _config.default_config()
-    import dataclasses
     _config.set_default_config(dataclasses.replace(
         cfg, raster_use_checkpoint=True,
         raster_checkpoint=path or cfg.raster_checkpoint))
 
 
 def disable_checkpoint() -> None:
-    import dataclasses
     _config.set_default_config(dataclasses.replace(
         _config.default_config(), raster_use_checkpoint=False))
 
 
 def set_checkpoint_dir(path: str) -> None:
-    import dataclasses
     _config.set_default_config(dataclasses.replace(
         _config.default_config(), raster_checkpoint=path))
 
@@ -75,7 +73,9 @@ def serialize_tile(tile: RasterTile,
     crash never leaves a partial file behind a valid name."""
     cfg = cfg or _config.default_config()
     payload = write_gtiff(tile)
-    meta = dict(tile.meta)
+    # a stale path from an earlier round trip must never survive: the
+    # tile content may have changed since that file was written
+    meta = {k: v for k, v in tile.meta.items() if k != "checkpoint_path"}
     if not cfg.raster_use_checkpoint:
         return {"cell_id": tile.cell_id, "raster": payload,
                 "metadata": meta}
@@ -85,9 +85,14 @@ def serialize_tile(tile: RasterTile,
     if not os.path.exists(path):
         fd, tmp = tempfile.mkstemp(dir=cfg.raster_checkpoint,
                                    suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     meta["checkpoint_path"] = path
     return {"cell_id": tile.cell_id, "raster": path, "metadata": meta}
 
@@ -101,7 +106,6 @@ def deserialize_tile(rec: dict) -> RasterTile:
     else:
         with open(raster, "rb") as f:
             tile = read_gtiff(f.read())
-    import dataclasses
     return dataclasses.replace(
         tile, cell_id=rec.get("cell_id"),
         meta=dict(tile.meta, **rec.get("metadata", {})))
