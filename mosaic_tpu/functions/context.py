@@ -72,6 +72,41 @@ class MosaicContext(RasterFunctions):
         from .registry import function_names
         return function_names(group)
 
+    def try_sql(self, fn, *args, **kwargs):
+        """Null-on-error wrapper (reference:
+        expressions/util/TrySql.scala — wraps any expression so a bad
+        row yields null instead of failing the job).  Columnar analogue:
+        the whole call returns None on error; pair with per-row loops
+        for row-level tolerance."""
+        try:
+            return fn(*args, **kwargs)
+        except Exception:
+            return None
+
+    def st_asmvttileagg(self, geoms: Geoms, attributes, z: int, x: int,
+                        y: int, layer: str = "layer") -> bytes:
+        """reference: ST_AsMVTTileAgg (expressions/geometry/
+        ST_AsMVTTileAgg.scala) — aggregate a group's geometries into one
+        Mapbox Vector Tile blob for slippy tile z/x/y."""
+        from ..io.vectortile import st_asmvttileagg
+        return st_asmvttileagg(geoms, attributes, z, x, y, layer)
+
+    def st_asgeojsontileagg(self, geoms: Geoms, attributes, z: int,
+                            x: int, y: int) -> str:
+        """reference: ST_AsGeojsonTileAgg — tile-clipped GeoJSON
+        FeatureCollection aggregate."""
+        from ..io.vectortile import st_asgeojsontileagg
+        return st_asgeojsontileagg(geoms, attributes, z, x, y)
+
+    def get_optimal_resolution(self, geoms: Geoms,
+                               cells_per_geometry: float = 16.0) -> int:
+        """reference: sql/MosaicAnalyzer.getOptimalResolution
+        (sql/MosaicAnalyzer.scala:10-39) — sample mean geometry area,
+        pick the resolution giving ~cells_per_geometry chips each."""
+        from ..analyzer import get_optimal_resolution
+        return get_optimal_resolution(geoms, self.index_system,
+                                      cells_per_geometry)
+
     # ------------------------------------------------------------------
     # constructors / format converters
     # (reference registrations: functions/MosaicContext.scala:212-276)

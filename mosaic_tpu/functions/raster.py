@@ -28,18 +28,47 @@ class RasterFunctions:
 
     # ------------------------------------------------------------ ingest
     def rst_fromfile(self, paths: Sequence[str]) -> List[RasterTile]:
-        """reference: RST_FromFile"""
+        """reference: RST_FromFile — driver by extension/magic: GeoTIFF,
+        NetCDF classic (first subdataset; use rst_getsubdataset for
+        others), Zarr directory/zip."""
+        import os as _os
         out = []
         for p in paths:
-            with open(p, "rb") as f:
-                t = read_gtiff(f.read())
+            low = p.lower()
+            is_zarr = _os.path.isdir(p) or (
+                low.endswith(".zip") and not low.endswith(".tif.zip"))
+            if is_zarr:
+                from ..io.zarr import read_zarr
+                subs = read_zarr(p)
+                if not subs:
+                    raise ValueError(
+                        f"{p}: no zarr arrays found (is this actually "
+                        "a zarr store?)")
+                t = subs[sorted(subs)[0]]
+            else:
+                with open(p, "rb") as f:
+                    blob = f.read()
+                t = self.rst_fromcontent([blob])[0]
             t.meta["path"] = p
             out.append(t)
         return out
 
     def rst_fromcontent(self, blobs: Sequence[bytes]) -> List[RasterTile]:
-        """reference: RST_FromContent"""
-        return [read_gtiff(b) for b in blobs]
+        """reference: RST_FromContent — GeoTIFF or NetCDF classic bytes
+        (magic-sniffed; NetCDF yields its first subdataset)."""
+        out = []
+        for b in blobs:
+            if b[:3] == b"CDF":
+                from ..io.netcdf import read_netcdf
+                subs = read_netcdf(b)
+                if not subs:
+                    raise ValueError(
+                        "NetCDF file has no 2D variables to expose "
+                        "as a raster")
+                out.append(subs[sorted(subs)[0]])
+            else:
+                out.append(read_gtiff(b))
+        return out
 
     def rst_frombands(self, bands: Sequence[RasterTile]) -> RasterTile:
         """Stack single-band tiles into one raster (reference:
@@ -199,13 +228,37 @@ class RasterFunctions:
         return np.asarray([int(t.valid_mask().sum()) for t in tiles])
 
     def rst_subdatasets(self, tiles: Tiles) -> List[dict]:
-        """GTiff has no subdatasets; empty map per tile (reference:
-        RST_Subdatasets over NetCDF/HDF)."""
-        return [{} for _ in tiles]
+        """Subdataset names per tile (reference: RST_Subdatasets over
+        NetCDF/Zarr; GTiff has none).  Multi-variable containers record
+        their sibling variables in tile.meta["subdatasets"]."""
+        out = []
+        for t in tiles:
+            names = t.meta.get("subdatasets", "")
+            out.append({n: n for n in names.split(",") if n})
+        return out
 
-    def rst_getsubdataset(self, tiles: Tiles, name: str):
-        raise ValueError("GTiff rasters have no subdatasets; "
-                         f"requested {name!r}")
+    def rst_getsubdataset(self, tiles: Tiles, name: str
+                          ) -> List[RasterTile]:
+        """reference: RST_GetSubdataset — reload the named variable from
+        the tile's source container."""
+        out = []
+        for t in tiles:
+            subs = t.meta.get("subdatasets", "")
+            if name not in subs.split(","):
+                raise ValueError(
+                    f"no subdataset {name!r} (have: {subs or 'none'})")
+            path = t.meta.get("path")
+            if path is None:
+                raise ValueError("tile has no source path to reload "
+                                 "a subdataset from")
+            if t.meta.get("driver") == "zarr":
+                from ..io.zarr import read_zarr
+                out.append(read_zarr(path)[name])
+            else:
+                from ..io.netcdf import read_netcdf
+                with open(path, "rb") as fh:
+                    out.append(read_netcdf(fh.read())[name])
+        return out
 
     # ------------------------------------------------- coordinate math
     def rst_rastertoworldcoord(self, tiles: Tiles, cols, rows

@@ -26,9 +26,26 @@ __all__ = ["raster_to_grid", "read_gtiff_files"]
 
 
 def read_gtiff_files(paths: Sequence[str],
-                     size_mb: Optional[float] = None) -> List[RasterTile]:
-    """GeoTIFF paths → tiles, optionally subdivided to a memory bound
-    (reference: GDALFileFormat + ReTileOnRead.localSubdivide)."""
+                     size_mb: Optional[float] = None,
+                     strategy: str = "in_memory") -> List:
+    """GeoTIFF paths → tiles, under one of the reference's read
+    strategies (datasource/gdal/ReadStrategy.scala:11-81):
+
+    - "in_memory":      decode now, tiles carry pixel arrays;
+    - "retile_on_read": decode + subdivide to ``size_mb`` (default 8)
+                        bounded tiles (ReTileOnRead.localSubdivide);
+    - "as_path":        defer decode — returns wire records
+                        {"raster": path, "metadata": {...}} resolvable
+                        with core.raster.checkpoint.deserialize_tile
+                        (ReadAsPath: tile = path through the shuffle).
+    """
+    if strategy == "as_path":
+        return [{"cell_id": None, "raster": p, "metadata": {"path": p}}
+                for p in paths]
+    if strategy == "retile_on_read" and size_mb is None:
+        size_mb = 8.0
+    elif strategy not in ("in_memory", "retile_on_read"):
+        raise ValueError(f"unknown read strategy {strategy!r}")
     tiles = []
     for p in paths:
         with open(p, "rb") as f:

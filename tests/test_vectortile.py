@@ -1,0 +1,110 @@
+"""MVT / GeoJSON tile aggregators + analyzer + misc round-3 surface."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.bench.workloads import nyc_zones
+from mosaic_tpu.core.index.factory import get_index_system
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.io.vectortile import (decode_mvt, st_asmvttileagg,
+                                      st_asgeojsontileagg,
+                                      tile_envelope_4326)
+
+
+@pytest.fixture(scope="module")
+def zones():
+    return nyc_zones(n_side=4, seed=2)
+
+
+def _nyc_tile():
+    # a z12 tile over lower Manhattan-ish
+    import math
+    z = 12
+    lon, lat = -74.0, 40.72
+    n = 2 ** z
+    x = int((lon + 180) / 360 * n)
+    y = int((1 - math.asinh(math.tan(math.radians(lat))) / math.pi)
+            / 2 * n)
+    return z, x, y
+
+
+def test_mvt_round_trip(zones):
+    z, x, y = _nyc_tile()
+    attrs = {"zone": [f"z{i}" for i in range(len(zones))],
+             "score": list(range(len(zones)))}
+    blob = st_asmvttileagg(zones, attrs, z, x, y, layer="zones")
+    assert isinstance(blob, bytes) and len(blob) > 20
+    dec = decode_mvt(blob)
+    lay = dec["zones"]
+    assert lay["version"] == 2 and lay["extent"] == 4096
+    assert len(lay["features"]) > 0
+    assert lay["keys"] == ["zone", "score"]
+    for f in lay["features"]:
+        assert f["type"] == 3                      # polygons
+        for ring in f["rings"]:
+            assert len(ring) >= 3
+            assert (ring >= -2).all() and (ring <= 4098).all()
+        # tags reference valid key/value slots
+        tags = f["tags"]
+        for ki, vi in zip(tags[0::2], tags[1::2]):
+            assert ki < len(lay["keys"]) and vi < len(lay["values"])
+    # the source attribute values survive
+    assert any(v == "z0" or str(v).startswith("z")
+               for v in lay["values"])
+
+
+def test_mvt_empty_tile(zones):
+    blob = st_asmvttileagg(zones, None, 12, 0, 0)     # far away tile
+    dec = decode_mvt(blob)
+    assert len(dec["layer"]["features"]) == 0
+
+
+def test_geojson_tile_agg(zones):
+    z, x, y = _nyc_tile()
+    out = st_asgeojsontileagg(zones, {"i": list(range(len(zones)))},
+                              z, x, y)
+    fc = json.loads(out)
+    assert fc["type"] == "FeatureCollection"
+    assert len(fc["features"]) > 0
+    box = tile_envelope_4326(z, x, y)
+    for f in fc["features"]:
+        assert f["geometry"]["type"] in ("MultiPolygon", "Polygon")
+        coords = np.array(f["geometry"]["coordinates"][0][0])
+        assert (coords[:, 0] >= box[0] - 1e-9).all()
+        assert (coords[:, 0] <= box[2] + 1e-9).all()
+
+
+def test_analyzer_optimal_resolution(zones):
+    mc = MosaicContext.build("H3")
+    res = mc.get_optimal_resolution(zones)
+    assert res in mc.index_system.resolutions()
+    # zones ~2km wide: plausible band
+    assert 6 <= res <= 10
+
+
+def test_try_sql(zones):
+    mc = MosaicContext.build("H3")
+    assert mc.try_sql(mc.st_geomfromwkt, ["POINT(1 2)"]) is not None
+    assert mc.try_sql(mc.st_geomfromwkt, ["POINT(1"]) is None
+
+
+def test_read_strategies(tmp_path, zones):
+    from mosaic_tpu.core.raster.checkpoint import deserialize_tile
+    from mosaic_tpu.core.raster.gtiff import write_gtiff
+    from mosaic_tpu.core.raster.tile import GeoTransform, RasterTile
+    from mosaic_tpu.io.raster_grid import read_gtiff_files
+    gt = GeoTransform(-74.1, 0.01, 0.0, 40.9, 0.0, -0.01)
+    t = RasterTile(np.arange(600.0).reshape(1, 20, 30), gt)
+    p = str(tmp_path / "t.tif")
+    open(p, "wb").write(write_gtiff(t))
+    mem = read_gtiff_files([p])
+    assert len(mem) == 1 and mem[0].width == 30
+    recs = read_gtiff_files([p], strategy="as_path")
+    assert recs[0]["raster"] == p
+    back = deserialize_tile(recs[0])
+    np.testing.assert_allclose(np.asarray(back.data),
+                               np.asarray(t.data))
+    with pytest.raises(ValueError):
+        read_gtiff_files([p], strategy="bogus")
