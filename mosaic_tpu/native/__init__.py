@@ -1,0 +1,100 @@
+"""Native (C++) exact-geometry kernels with transparent fallback.
+
+Reference counterpart: the native layer the reference reaches through
+JNI — JTS/GEOS-class exact geometry.  geokernels.cpp compiles on first
+use with the toolchain g++ (plain C ABI, loaded via ctypes — no
+pybind11 in this image); when no compiler is available every entry
+point returns None and callers keep their numpy path, so the framework
+never *requires* native code, it just gets faster with it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(os.path.dirname(__file__), "geokernels.cpp")
+    cache = os.path.join(tempfile.gettempdir(), "mosaic_tpu_native")
+    os.makedirs(cache, exist_ok=True)
+    lib_path = os.path.join(cache, "geokernels.so")
+    if not os.path.exists(lib_path) or \
+            os.path.getmtime(lib_path) < os.path.getmtime(src):
+        tmp = lib_path + ".build"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, lib_path)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    lib.pip_first_match.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.recheck_zones.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        if not os.environ.get("MOSAIC_TPU_DISABLE_NATIVE"):
+            _LIB = _build_and_load()
+    return _LIB
+
+
+def pip_first_match(points: np.ndarray, edges: np.ndarray,
+                    geom_start: np.ndarray) -> Optional[np.ndarray]:
+    """First geometry containing each point (crossing number), or None
+    when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    pts = np.ascontiguousarray(points, np.float64)
+    ed = np.ascontiguousarray(edges, np.float64)
+    gs = np.ascontiguousarray(geom_start, np.int64)
+    out = np.empty(len(pts), np.int32)
+    lib.pip_first_match(
+        pts.ctypes.data, len(pts), ed.ctypes.data, gs.ctypes.data,
+        len(gs) - 1, out.ctypes.data)
+    return out
+
+
+def recheck_zones(points: np.ndarray, group: np.ndarray,
+                  edges: np.ndarray, ezslot: np.ndarray,
+                  gstart: np.ndarray,
+                  gzones: np.ndarray) -> Optional[np.ndarray]:
+    """Chip-parity zone per (point, group); None when unavailable.
+    gzones zcap must be <= 16 (zone-slot count per cell)."""
+    lib = get_lib()
+    if lib is None or gzones.shape[1] > 16:
+        return None
+    pts = np.ascontiguousarray(points, np.float64)
+    grp = np.ascontiguousarray(group, np.int64)
+    ed = np.ascontiguousarray(edges, np.float64)
+    ez = np.ascontiguousarray(ezslot, np.int32)
+    gs = np.ascontiguousarray(gstart, np.int64)
+    gz = np.ascontiguousarray(gzones, np.int32)
+    out = np.empty(len(pts), np.int32)
+    lib.recheck_zones(
+        pts.ctypes.data, grp.ctypes.data, len(pts), ed.ctypes.data,
+        ez.ctypes.data, gs.ctypes.data, gz.ctypes.data,
+        gz.shape[1], out.ctypes.data)
+    return out

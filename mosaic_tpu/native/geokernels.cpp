@@ -1,0 +1,102 @@
+// Exact-geometry host kernels (C++): the native layer of the framework.
+//
+// Reference counterpart: the compute-heavy geometry work the reference
+// reaches through native code — JTS (JVM but the hot kernel),
+// GEOS-class robust predicates behind GDAL/OGR (C++ via JNI).  The
+// device path (JAX/XLA) owns throughput; these kernels own the exact
+// float64 host passes (PIP oracle / recheck) that the f32 exactness
+// contract leans on, replacing per-polygon numpy broadcasting with
+// tight loops + bbox pruning.
+//
+// Plain C ABI (ctypes), no Python headers: builds with a bare
+// `g++ -O3 -shared -fPIC` and degrades to the numpy path when no
+// compiler is present (native/__init__.py).
+
+#include <cstdint>
+#include <cstddef>
+#include <vector>
+
+extern "C" {
+
+// Crossing-number point-in-polygon, half-open rule identical to
+// tessellate._pip: straddle = (ay <= py) != (by <= py); hit if px < xi.
+// pts [n_pts, 2]; edges [n_edges, 4] = ax, ay, bx, by;
+// geom_start [n_geoms + 1] CSR over edges; out [n_pts] = first geometry
+// containing the point, or -1.
+void pip_first_match(const double* pts, int64_t n_pts,
+                     const double* edges, const int64_t* geom_start,
+                     int64_t n_geoms, int32_t* out) {
+    // per-geometry bbox prune
+    std::vector<double> bx0(n_geoms), by0(n_geoms), bx1(n_geoms),
+        by1(n_geoms);
+    for (int64_t g = 0; g < n_geoms; ++g) {
+        double x0 = 1e300, y0 = 1e300, x1 = -1e300, y1 = -1e300;
+        for (int64_t e = geom_start[g]; e < geom_start[g + 1]; ++e) {
+            const double* ed = edges + 4 * e;
+            double lo_x = ed[0] < ed[2] ? ed[0] : ed[2];
+            double hi_x = ed[0] < ed[2] ? ed[2] : ed[0];
+            double lo_y = ed[1] < ed[3] ? ed[1] : ed[3];
+            double hi_y = ed[1] < ed[3] ? ed[3] : ed[1];
+            if (lo_x < x0) x0 = lo_x;
+            if (hi_x > x1) x1 = hi_x;
+            if (lo_y < y0) y0 = lo_y;
+            if (hi_y > y1) y1 = hi_y;
+        }
+        bx0[g] = x0; by0[g] = y0; bx1[g] = x1; by1[g] = y1;
+    }
+    for (int64_t i = 0; i < n_pts; ++i) {
+        const double px = pts[2 * i], py = pts[2 * i + 1];
+        int32_t hit = -1;
+        for (int64_t g = 0; g < n_geoms && hit < 0; ++g) {
+            if (px < bx0[g] || px > bx1[g] || py < by0[g] ||
+                py > by1[g]) continue;
+            int64_t crossings = 0;
+            for (int64_t e = geom_start[g]; e < geom_start[g + 1]; ++e) {
+                const double* ed = edges + 4 * e;
+                const double ay = ed[1], by = ed[3];
+                if ((ay <= py) != (by <= py)) {
+                    const double ax = ed[0], bxx = ed[2];
+                    const double t = (py - ay) / (by - ay);
+                    const double xi = ax + t * (bxx - ax);
+                    if (px < xi) ++crossings;
+                }
+            }
+            if (crossings & 1) hit = (int32_t)g;
+        }
+        out[i] = hit;
+    }
+}
+
+// Per-(point, group) chip-parity zone assignment — the native recheck
+// core.  pts [n, 2]; group[n] (CSR row per point, -1 = skip);
+// edges [E, 4]; ezslot [E]; gstart [G+1]; gzones [G, zcap];
+// out [n] zone or -1.
+void recheck_zones(const double* pts, const int64_t* group, int64_t n,
+                   const double* edges, const int32_t* ezslot,
+                   const int64_t* gstart, const int32_t* gzones,
+                   int64_t zcap, int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t g = group[i];
+        out[i] = -1;
+        if (g < 0) continue;
+        const double px = pts[2 * i], py = pts[2 * i + 1];
+        int64_t counts[16] = {0};
+        for (int64_t e = gstart[g]; e < gstart[g + 1]; ++e) {
+            const double* ed = edges + 4 * e;
+            const double ay = ed[1], by = ed[3];
+            if ((ay <= py) != (by <= py)) {
+                const double t = (py - ay) / (by - ay);
+                const double xi = ed[0] + t * (ed[2] - ed[0]);
+                if (px < xi) {
+                    const int32_t z = ezslot[e];
+                    if (z >= 0 && z < 16) ++counts[z];
+                }
+            }
+        }
+        for (int64_t z = 0; z < zcap && z < 16; ++z) {
+            if (counts[z] & 1) { out[i] = gzones[g * zcap + z]; break; }
+        }
+    }
+}
+
+}  // extern "C"

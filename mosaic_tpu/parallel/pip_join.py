@@ -561,9 +561,26 @@ def make_dense_pip_join_fn(idx: DensePIPIndex, eps: float = EPS_EDGE_DEG,
         err_lat = max(err_lat, margin_eps_deg * np.pi / 180.0 * scale)
     far_lim = np.float32(idx.ext_deg + 0.05)
 
+    import os
+    use_pallas = os.environ.get("MOSAIC_PIP_PALLAS", "").lower() in (
+        "1", "true", "yes")
+    if use_pallas:
+        # the Pallas kernel runs df arithmetic regardless of the
+        # requested precision; the margin threshold must match it
+        err_lat = max(err_lat, err_lattice_bound(
+            idx.res, "df", idx.ext_deg, localized=True))
+
     def fn(points):
-        face, ai, bi, margin, facegap = project_lattice_jax(
-            points, idx.res, idx.origin, precision=precision)
+        if use_pallas:
+            # opt-in Pallas projection kernel (ops/pallas_projection.py)
+            # until validated on hardware; same contract, same outputs
+            from ..ops.pallas_projection import project_lattice_pallas
+            face, ai, bi, margin, facegap = project_lattice_pallas(
+                points, idx.res,
+                (float(idx.origin[0]), float(idx.origin[1])))
+        else:
+            face, ai, bi, margin, facegap = project_lattice_jax(
+                points, idx.res, idx.origin, precision=precision)
         far = (jnp.abs(points[..., 0]) > far_lim) | \
             (jnp.abs(points[..., 1]) > far_lim)
         ia = ai - idx.a0
@@ -686,11 +703,30 @@ def pip_host_truth(points64: np.ndarray,
                    polys: GeometryArray) -> np.ndarray:
     """The exact float64 host oracle: first polygon containing each point
     (crossing-number, first-match tie-break) — the single source of truth
-    that host_recheck, tests and bench all compare against."""
+    that host_recheck, tests and bench all compare against.
+
+    Routes through the native C++ kernel (mosaic_tpu.native, the
+    JTS/GEOS-analogue layer) when the toolchain built it — bit-identical
+    crossing rule — and falls back to the numpy broadcast loop."""
     from ..core.tessellate import _pip, _poly_edges
+    edges_list = [_poly_edges(polys, gi) for gi in range(len(polys))]
+    try:
+        from .. import native
+    except ImportError:
+        native = None
+    if native is not None and len(polys):
+        gs = np.zeros(len(polys) + 1, np.int64)
+        np.cumsum([len(e) for e in edges_list], out=gs[1:])
+        flat = np.concatenate(edges_list).reshape(-1, 4)
+        # unavailability is signalled by None (no compiler); real
+        # errors must raise, not silently fall back to the slow path
+        out = native.pip_first_match(np.asarray(points64)[:, :2], flat,
+                                     gs)
+        if out is not None:
+            return out
     truth = np.full(len(points64), -1, np.int32)
     for gi in range(len(polys)):
-        inside = _pip(points64, _poly_edges(polys, gi))
+        inside = _pip(points64, edges_list[gi])
         truth = np.where((truth < 0) & inside, gi, truth)
     return truth
 

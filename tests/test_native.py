@@ -1,0 +1,107 @@
+"""Native C++ geometry kernels (mosaic_tpu/native) vs the numpy path.
+
+Reference counterpart: the JNI boundary tests — the same results must
+come out of the native and managed implementations.  When no g++ is
+available the native path returns None and these tests skip (the
+framework contract is graceful fallback, not hard dependency).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import native
+from mosaic_tpu.bench.workloads import taxi_zones
+from mosaic_tpu.core.tessellate import _pip, _poly_edges
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if native.get_lib() is None:
+        pytest.skip("no C++ toolchain / native build failed")
+    return native.get_lib()
+
+
+def test_pip_first_match_parity(lib, rng):
+    polys = taxi_zones(5)
+    edges_list = [_poly_edges(polys, g) for g in range(len(polys))]
+    gs = np.zeros(len(polys) + 1, np.int64)
+    np.cumsum([len(e) for e in edges_list], out=gs[1:])
+    flat = np.concatenate(edges_list).reshape(-1, 4)
+    pts = np.stack([rng.uniform(-74.35, -73.6, 40_000),
+                    rng.uniform(40.4, 41.0, 40_000)], -1)
+    got = native.pip_first_match(pts, flat, gs)
+    want = np.full(len(pts), -1, np.int32)
+    for gi in range(len(polys)):
+        inside = _pip(pts, edges_list[gi])
+        want = np.where((want < 0) & inside, gi, want)
+    assert np.array_equal(got, want)
+    assert (got >= 0).any() and (got < 0).any()
+
+
+def test_pip_host_truth_uses_native(lib):
+    """pip_host_truth output is identical whichever path runs."""
+    import os
+    from mosaic_tpu.parallel.pip_join import pip_host_truth
+    polys = taxi_zones(4)
+    rng = np.random.default_rng(3)
+    pts = np.stack([rng.uniform(-74.3, -73.65, 20_000),
+                    rng.uniform(40.45, 40.95, 20_000)], -1)
+    a = pip_host_truth(pts, polys)
+    # force the numpy fallback and compare
+    os.environ["MOSAIC_TPU_DISABLE_NATIVE"] = "1"
+    native._LIB, native._TRIED = None, True
+    try:
+        b = pip_host_truth(pts, polys)
+    finally:
+        del os.environ["MOSAIC_TPU_DISABLE_NATIVE"]
+        native._TRIED = False
+    assert np.array_equal(a, b)
+
+
+def test_recheck_zones_parity(lib, rng):
+    """Native chip-parity recheck == the vectorized numpy recheck."""
+    edges = []
+    zslot = []
+    gstart = [0]
+    gzones = []
+    for g in range(50):
+        cx, cy = rng.uniform(0, 10, 2)
+        n_chip = rng.integers(1, 4)
+        zs = []
+        for c in range(n_chip):
+            r = rng.uniform(0.2, 0.6)
+            ang = np.linspace(0, 2 * np.pi, 7)[:-1] + rng.uniform(0, 1)
+            ring = np.stack([cx + r * np.cos(ang),
+                             cy + r * np.sin(ang)], -1)
+            a = ring
+            b = np.roll(ring, -1, axis=0)
+            for i in range(len(ring)):
+                edges.append([a[i, 0], a[i, 1], b[i, 0], b[i, 1]])
+                zslot.append(c)
+            zs.append(100 + g * 4 + c)
+        gstart.append(len(edges))
+        gzones.append(zs + [-1] * (4 - len(zs)))
+    edges = np.asarray(edges)
+    zslot = np.asarray(zslot, np.int32)
+    gstart = np.asarray(gstart, np.int64)
+    gzones = np.asarray(gzones, np.int32)
+    pts = rng.uniform(-1, 11, (30_000, 2))
+    group = rng.integers(-1, 50, 30_000)
+
+    got = native.recheck_zones(pts, group, edges, zslot, gstart, gzones)
+    want = np.full(len(pts), -1, np.int32)
+    for i in range(len(pts)):
+        g = group[i]
+        if g < 0:
+            continue
+        counts = np.zeros(4, np.int64)
+        for e in range(gstart[g], gstart[g + 1]):
+            ax, ay, bx, by = edges[e]
+            if (ay <= pts[i, 1]) != (by <= pts[i, 1]):
+                t = (pts[i, 1] - ay) / (by - ay)
+                if pts[i, 0] < ax + t * (bx - ax):
+                    counts[zslot[e]] += 1
+        odd = np.nonzero(counts & 1)[0]
+        if len(odd):
+            want[i] = gzones[g, odd[0]]
+    assert np.array_equal(got, want)

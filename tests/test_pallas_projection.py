@@ -1,0 +1,49 @@
+"""Pallas projection kernel (ops/pallas_projection.py), interpret mode.
+
+CPU interpret mode cannot validate the df precision (XLA:CPU contracts
+the barrier-free Dekker chains — see the module docstring); these tests
+pin the kernel's STRUCTURE: same lattice cells as the reference df path
+everywhere except a sliver of low-margin points, and margins/facegaps in
+agreement.  tests_tpu/ holds the hardware precision contract.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mosaic_tpu.core.index.h3.jaxkernel import project_lattice_jax
+from mosaic_tpu.ops.pallas_projection import project_lattice_pallas
+
+
+@pytest.mark.parametrize("res", [7, 9])
+def test_pallas_matches_df_path_structurally(res):
+    rng = np.random.default_rng(6)
+    origin = (-74.0, 40.7)
+    n = 20_000
+    loc = np.stack([rng.uniform(-0.4, 0.4, n),
+                    rng.uniform(-0.3, 0.3, n)], -1).astype(np.float32)
+    f1, a1, b1, m1, g1 = [np.asarray(v) for v in project_lattice_pallas(
+        jnp.asarray(loc), res, origin, interpret=True)]
+    f2, a2, b2, m2, g2 = [np.asarray(v) for v in jax.jit(
+        lambda p: project_lattice_jax(p, res, np.asarray(origin),
+                                      precision="df"))(jnp.asarray(loc))]
+    same = (f1 == f2) & (a1 == a2) & (b1 == b2)
+    # disagreements can only sit on cell boundaries (tiny margins)
+    assert same.mean() > 0.999
+    if (~same).any():
+        assert np.max(np.minimum(m1[~same], m2[~same])) < 1e-3
+    np.testing.assert_allclose(m1[same], m2[same], atol=2e-3)
+    np.testing.assert_allclose(g1, g2, atol=1e-5)
+
+
+def test_pallas_padding_and_small_batches():
+    origin = (-74.0, 40.7)
+    loc = np.array([[0.01, 0.02], [-0.3, 0.25], [0.0, 0.0]], np.float32)
+    f, a, b, m, g = project_lattice_pallas(jnp.asarray(loc), 9, origin,
+                                           interpret=True)
+    assert f.shape == (3,)
+    f2, a2, b2, m2, g2 = project_lattice_jax(
+        jnp.asarray(loc), 9, np.asarray(origin), precision="df")
+    assert np.array_equal(np.asarray(f), np.asarray(f2))
+    assert np.array_equal(np.asarray(a), np.asarray(a2))
