@@ -105,6 +105,40 @@ def main():
     log(f"counties: {len(counties)} polys -> {len(cchips)} chips "
         f"(res 5) in {t_counties:.1f}s")
 
+    # BASELINE config 3: polygon x polygon overlay (footprints x zones)
+    from mosaic_tpu.parallel.overlay import (overlay_host_truth,
+                                             overlay_intersects)
+    from mosaic_tpu.core.geometry.array import GeometryBuilder
+    rngo = np.random.default_rng(41)
+    fb = GeometryBuilder()
+    for _ in range(400):
+        cx = rngo.uniform(-74.2, -73.75)
+        cy = rngo.uniform(40.55, 40.85)
+        w_, h_ = rngo.uniform(2e-4, 2e-3, 2)
+        fb.add_polygon(np.array(
+            [[cx - w_, cy - h_], [cx + w_, cy - h_], [cx + w_, cy + h_],
+             [cx - w_, cy + h_], [cx - w_, cy - h_]]))
+    foot = fb.finish()
+    t0 = time.time()
+    ov = overlay_intersects(foot, polys, res, grid)
+    t_overlay = time.time() - t0
+    ov_mism = int(np.sum(ov != overlay_host_truth(foot, polys)))
+    log(f"overlay: 400 footprints x {len(polys)} zones in "
+        f"{t_overlay:.2f}s; parity mismatches {ov_mism}")
+
+    # BASELINE config 5: raster -> grid tessellation/aggregation
+    from mosaic_tpu.core.raster.tile import GeoTransform, RasterTile
+    from mosaic_tpu.io.raster_grid import raster_to_grid
+    gtr = GeoTransform(-74.25, 0.0005, 0.0, 40.92, 0.0, -0.0005)
+    yy, xx = np.mgrid[0:800, 0:1000]
+    dem = RasterTile((np.sin(xx / 60.0) * 50 + yy * 0.1)[None], gtr,
+                     srid=4326)
+    t0 = time.time()
+    r2g = raster_to_grid([dem], 8, grid, combiner="avg")
+    t_r2g = time.time() - t0
+    log(f"raster_to_grid: 1000x800 px -> {len(r2g)} res-8 cells in "
+        f"{t_r2g:.2f}s")
+
     # BASELINE config 4: SpatialKNN (AIS pings x ports stand-in)
     from mosaic_tpu.bench.workloads import nyc_points as _pts
     from mosaic_tpu.models import SpatialKNN, knn_host_truth
@@ -203,6 +237,10 @@ def main():
         "county_chips": len(cchips),
         "knn_rows_per_sec": round(knn_pps),
         "knn_parity_mismatches": knn_mism,
+        "overlay_s": round(t_overlay, 2),
+        "overlay_parity_mismatches": ov_mism,
+        "raster_to_grid_s": round(t_r2g, 2),
+        "raster_to_grid_cells": len(r2g),
     }))
 
 

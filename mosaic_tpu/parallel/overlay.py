@@ -131,14 +131,17 @@ def _chip_pair_test(ea, eb):
     pad = (jnp.abs(ea[:, None, 0]) > 1e8) | \
         (jnp.abs(eb[None, :, 0]) > 1e8)
     proper = ((d1 > 0) != (d2 > 0)) & ((d3 > 0) != (d4 > 0)) & ~pad
-    # scale-aware degeneracy band: |orient| ~ len1*len2*sin(angle);
-    # normalize by segment length products
-    l1 = jnp.linalg.norm(b1 - a1, axis=-1)
-    l2 = jnp.linalg.norm(b2 - a2, axis=-1)
-    scale = jnp.maximum(l1 * l2, 1e-30)
-    tiny = (jnp.minimum(jnp.minimum(jnp.abs(d1), jnp.abs(d2)),
-                        jnp.minimum(jnp.abs(d3), jnp.abs(d4))) / scale
-            < EPS_DEG) & ~pad
+    # hazard band: an endpoint within EPS_DEG (absolute degrees) of the
+    # other segment's line — |orient|/len(other) IS that perpendicular
+    # distance.  (A len1*len2 normalization made the band proportional
+    # to edge length: a ~100 m footprint edge got a 5e-10 deg band and a
+    # real f32 miscall shipped unflagged — caught by the bench's
+    # overlay parity check.)
+    l1 = jnp.maximum(jnp.linalg.norm(b1 - a1, axis=-1), 1e-30)
+    l2 = jnp.maximum(jnp.linalg.norm(b2 - a2, axis=-1), 1e-30)
+    tiny = ((jnp.minimum(jnp.abs(d1), jnp.abs(d2)) / l2 < EPS_DEG) |
+            (jnp.minimum(jnp.abs(d3), jnp.abs(d4)) / l1 < EPS_DEG)) & \
+        ~pad
     crossing = jnp.any(proper)
 
     def contains(point, e):

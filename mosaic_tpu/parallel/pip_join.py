@@ -646,6 +646,13 @@ def host_recheck_fn(idx: DensePIPIndex):
     assert aux is not None, "recheck needs the build-time aux tables"
     entry = np.asarray(idx.entry)
     Z = int(idx.gzones.shape[1])
+    # native-kernel tables, prepared ONCE at bind time (per-call work
+    # must scale with the flagged subset, not the chip-edge pool)
+    flat_native = np.ascontiguousarray(
+        np.concatenate([aux["flat_a"], aux["flat_b"]], axis=1))
+    ezslot_native = aux["edge_zslot"].astype(np.int32)
+    gzones_native = np.ascontiguousarray(
+        aux["gzones64"].astype(np.int32))
 
     def recheck(points64: np.ndarray, zone: np.ndarray,
                 uncertain: np.ndarray) -> np.ndarray:
@@ -667,6 +674,21 @@ def host_recheck_fn(idx: DensePIPIndex):
         isb = (e >= 0) & ~is_core
         bsel = np.nonzero(isb)[0]
         if len(bsel):
+            # native chip-parity core when the C++ layer is available
+            try:
+                from .. import native
+            except ImportError:
+                native = None
+            if native is not None:
+                grp = np.full(len(sel), -1, np.int64)
+                grp[bsel] = e[bsel]
+                nz = native.recheck_zones(
+                    pts, grp, flat_native, ezslot_native,
+                    aux["gstart"], gzones_native)
+                if nz is not None:
+                    out[bsel] = nz[bsel]
+                    zone[sel] = out
+                    return zone
             g = e[bsel].astype(np.int64)
             gstart = aux["gstart"]
             cnt = (gstart[g + 1] - gstart[g]).astype(np.int64)

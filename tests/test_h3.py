@@ -238,3 +238,22 @@ def test_candidate_cells_stream_large_extent():
     assert len(allc) == len(np.unique(allc)), "stream emitted dupes"
     direct = grid.candidate_cells(bbox, res)
     assert len(np.setdiff1d(direct, allc)) == 0
+
+
+def test_grid_distance_closed_form_long_range():
+    """Same-face pairs any distance apart resolve without ring walks
+    (regression: 64-ring BFS cap raised on distant pairs)."""
+    from mosaic_tpu.core.index.factory import get_index_system
+    grid = get_index_system("H3")
+    a = grid.point_to_cell(np.array([[-74.0, 40.7]]), 9)
+    b = grid.point_to_cell(np.array([[-73.0, 41.2]]), 9)   # ~100km away
+    d = grid.grid_distance(a, b)
+    assert d[0] > 200        # far beyond the old 64-ring cap
+    # consistency with the BFS for a near pair
+    c = grid.point_to_cell(np.array([[-73.998, 40.701]]), 9)
+    d2 = grid.grid_distance(a, c)
+    ring = grid.k_ring(a, int(d2[0]))
+    assert c[0] in ring[0]
+    if d2[0] > 0:
+        inner = grid.k_ring(a, int(d2[0]) - 1)
+        assert c[0] not in inner[0]

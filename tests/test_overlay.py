@@ -78,3 +78,31 @@ def test_overlay_disjoint_sets(grid):
     b = bld.finish()
     got = overlay_intersects(a, b, 9, grid)
     assert not got.any()
+
+
+def test_overlay_near_touch_corner(grid):
+    """A footprint corner within ~1e-8 deg of a zone edge (outside):
+    the f32 crossing test can miscall this, so the hazard band must
+    flag it and the f64 recheck must return False (regression: a
+    length-proportional hazard normalization let this ship unflagged)."""
+    zone_ring = np.array([[-74.0, 40.7], [-73.95, 40.7],
+                          [-73.99538953140, 40.77723034],
+                          [-74.0, 40.75], [-74.0, 40.7]])
+    b = GeometryBuilder()
+    b.add_polygon(zone_ring)
+    zones = b.finish()
+    # point on the edge between verts 1 and 2, nudged outward 1e-8
+    p1 = zone_ring[1]
+    p2 = zone_ring[2]
+    t = 0.63
+    px = p1[0] + t * (p2[0] - p1[0]) + 1e-8
+    py = p1[1] + t * (p2[1] - p1[1])
+    w = 5e-4
+    fb = GeometryBuilder()
+    fb.add_polygon(np.array([[px, py - w], [px + w, py - w],
+                             [px + w, py + w], [px, py + w],
+                             [px, py - w]]))
+    foot = fb.finish()
+    got = overlay_intersects(foot, zones, 9, grid)
+    want = overlay_host_truth(foot, zones)
+    assert np.array_equal(got, want)
