@@ -359,28 +359,31 @@ class H3IndexSystem(IndexSystem):
         out[a == b] = 0
         ra = self.resolution_of(a)
         rb = self.resolution_of(b)
-        if np.any(ra != rb) or (len(ra) and np.any(ra != ra[0])):
-            # same contract as BNG (and h3Distance): one uniform res
+        if np.any(ra != rb):
+            # same contract as BNG (and h3Distance): per-pair equal res
             raise ValueError("grid_distance requires equal resolutions")
         todo = np.nonzero(out < 0)[0]
         if len(todo):
             from .hexmath import (hex2d_to_ijk, ijk_to_axial,
                                   project_lattice)
-            ca = self.cell_center(a[todo])
-            cb = self.cell_center(b[todo])
-            res = int(ra[0])
-            fa, ha = project_lattice(
-                np.radians(ca[:, ::-1]), res)
-            fb, hb = project_lattice(
-                np.radians(cb[:, ::-1]), res)
-            aa, ab = ijk_to_axial(hex2d_to_ijk(ha))
-            ba, bb2 = ijk_to_axial(hex2d_to_ijk(hb))
-            same = fa == fb
-            da = aa - ba
-            db = ab - bb2
-            dist = (np.abs(da) + np.abs(db) + np.abs(da - db)) // 2
-            out[todo[same]] = dist[same]
-            todo = todo[~same]
+            leftover = []
+            for res in np.unique(ra[todo]):
+                sel = todo[ra[todo] == res]
+                ca = self.cell_center(a[sel])
+                cb = self.cell_center(b[sel])
+                fa, ha = project_lattice(
+                    np.radians(ca[:, ::-1]), int(res))
+                fb, hb = project_lattice(
+                    np.radians(cb[:, ::-1]), int(res))
+                aa, ab = ijk_to_axial(hex2d_to_ijk(ha))
+                ba, bb2 = ijk_to_axial(hex2d_to_ijk(hb))
+                same = fa == fb
+                da = aa - ba
+                db = ab - bb2
+                dist = (np.abs(da) + np.abs(db) + np.abs(da - db)) // 2
+                out[sel[same]] = dist[same]
+                leftover.append(sel[~same])
+            todo = np.concatenate(leftover) if leftover else todo[:0]
         cap = 64
         k = 0
         while len(todo) and k < cap:

@@ -72,6 +72,17 @@ class MosaicContext(RasterFunctions):
         from .registry import function_names
         return function_names(group)
 
+    def call(self, name: str, *args, **kwargs):
+        """Invoke a registered function by its SQL-surface name — the
+        string-dispatch entry external engines use (reference: the SQL
+        registration path, sql/extensions/MosaicSQL.scala, where every
+        function is reachable by name)."""
+        from .registry import REGISTRY
+        if name not in REGISTRY:
+            raise ValueError(f"unknown function {name!r} (see "
+                             "function_names())")
+        return getattr(self, name)(*args, **kwargs)
+
     def try_sql(self, fn, *args, **kwargs):
         """Null-on-error wrapper (reference:
         expressions/util/TrySql.scala — wraps any expression so a bad

@@ -104,7 +104,7 @@ def _hash_dest(cell, n_dev: int):
     return (h % n_dev + n_dev).astype(jnp.int32) % n_dev
 
 
-def _chip_pair_test(ea, eb):
+def _chip_pair_test(ea, eb, eps=EPS_DEG):
     """f32 intersects + hazard flag for one chip pair.
 
     ea, eb [E, 4] (ax, ay, bx, by; 1e9 sentinel padding).  Returns
@@ -112,7 +112,9 @@ def _chip_pair_test(ea, eb):
     representative vertex of one inside the other (if no edges cross,
     the chips are disjoint or nested — one containment test each way
     decides).  hazard = any orientation test or containment crossing
-    within EPS of zero."""
+    within ``eps`` (absolute degrees; the caller scales it with the
+    local-frame extent so it always covers f32 coordinate
+    quantization)."""
     import jax.numpy as jnp
 
     a1 = ea[:, None, 0:2]
@@ -139,8 +141,8 @@ def _chip_pair_test(ea, eb):
     # overlay parity check.)
     l1 = jnp.maximum(jnp.linalg.norm(b1 - a1, axis=-1), 1e-30)
     l2 = jnp.maximum(jnp.linalg.norm(b2 - a2, axis=-1), 1e-30)
-    tiny = ((jnp.minimum(jnp.abs(d1), jnp.abs(d2)) / l2 < EPS_DEG) |
-            (jnp.minimum(jnp.abs(d3), jnp.abs(d4)) / l1 < EPS_DEG)) & \
+    tiny = ((jnp.minimum(jnp.abs(d1), jnp.abs(d2)) / l2 < eps) |
+            (jnp.minimum(jnp.abs(d3), jnp.abs(d4)) / l1 < eps)) & \
         ~pad
     crossing = jnp.any(proper)
 
@@ -153,9 +155,9 @@ def _chip_pair_test(ea, eb):
         xi = ax + t * (bx - ax)
         hits = straddle & (px < xi)
         inside = (jnp.sum(hits) & 1).astype(bool)
-        near = jnp.any(straddle & (jnp.abs(px - xi) < EPS_DEG)) | \
-            jnp.any((jnp.abs(py - ay) < EPS_DEG) & ~epad &
-                    (px < jnp.maximum(ax, bx) + EPS_DEG))
+        near = jnp.any(straddle & (jnp.abs(px - xi) < eps)) | \
+            jnp.any((jnp.abs(py - ay) < eps) & ~epad &
+                    (px < jnp.maximum(ax, bx) + eps))
         return inside, near
 
     ina, na = contains(ea[0, 0:2], eb)
@@ -167,7 +169,8 @@ def _chip_pair_test(ea, eb):
 
 def _local_sorted_join(cell_a, geom_a, edges_a, valid_a,
                        cell_b, geom_b, edges_b, valid_b,
-                       ga: int, gb: int, dup_cap: int):
+                       ga: int, gb: int, dup_cap: int,
+                       eps: float = EPS_DEG):
     """Sorted-table probe join of local rows; returns (hits [ga, gb]
     i32, hazards [ga, gb] i32, max_dup_needed)."""
     import jax
@@ -185,7 +188,8 @@ def _local_sorted_join(cell_a, geom_a, edges_a, valid_a,
                              side="right")
     dup_needed = jnp.max(jnp.where(valid_b, upper - start, 0))
 
-    pair_fn = jax.vmap(_chip_pair_test)
+    pair_fn = jax.vmap(
+        lambda ea, eb: _chip_pair_test(ea, eb, jnp.float32(eps)))
     na = key_a.shape[0]
 
     # duplicate probe as a fori_loop: program size stays constant when
@@ -214,7 +218,8 @@ def _local_sorted_join(cell_a, geom_a, edges_a, valid_a,
 
 def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
                     mesh=None, axis: str = "data",
-                    bucket_cap: int = 0, dup_cap: int = 8):
+                    bucket_cap: int = 0, dup_cap: int = 8,
+                    eps: float = EPS_DEG):
     """Build the (optionally sharded) overlay ST_Intersects kernel.
 
     Returns fn(cell_a, geom_a, edges_a, valid_a, cell_b, ...) ->
@@ -228,7 +233,7 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
     if mesh is None:
         def fn(ca, gea, ea, va, cb, geb, eb, vb):
             h, z, dn = _local_sorted_join(ca, gea, ea, va, cb, geb, eb,
-                                          vb, ga, gb, dup_cap)
+                                          vb, ga, gb, dup_cap, eps)
             return h, z, jnp.stack([jnp.int32(0), jnp.int32(0),
                                     dn.astype(jnp.int32)])
         return jax.jit(fn)
@@ -275,7 +280,7 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
         ca, gea, ea, va, ofa = exchange(ca, gea, ea, va, edge_cap_a)
         cb, geb, eb, vb, ofb = exchange(cb, geb, eb, vb, edge_cap_b)
         h, z, dn = _local_sorted_join(ca, gea, ea, va, cb, geb, eb, vb,
-                                      ga, gb, dup_cap)
+                                      ga, gb, dup_cap, eps)
         diag = jnp.stack([ofa.astype(jnp.int32), ofb.astype(jnp.int32),
                           dn.astype(jnp.int32)])
         return (jax.lax.psum(h, axis), jax.lax.psum(z, axis),
@@ -339,6 +344,15 @@ def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
     ca, gea, ea, va = rows_a[:4]
     cb, geb, eb, vb = rows_b[:4]
     ga, gb = len(polys_a), len(polys_b)
+    # hazard band scaled with the local-frame extent: f32 quantization
+    # of a coordinate of magnitude m displaces vertices by ~ulp(m), so
+    # a fixed 1e-6 band under-flags continent-scale inputs
+    ext = 1.0
+    for arr in (ea, eb):
+        fin = arr[np.abs(arr) < 1e8]
+        if len(fin):
+            ext = max(ext, float(np.abs(fin).max()))
+    eps = max(EPS_DEG, 64.0 * float(np.spacing(np.float32(ext))))
 
     dup_cap = 8
     if mesh is not None:
@@ -356,11 +370,12 @@ def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
     while True:
         if mesh is None:
             fn = make_overlay_fn(ga, gb, ea.shape[1], eb.shape[1],
-                                 dup_cap=dup_cap)
+                                 dup_cap=dup_cap, eps=eps)
         else:
             fn = make_overlay_fn(ga, gb, ea.shape[1], eb.shape[1],
                                  mesh=mesh, axis=axis,
-                                 bucket_cap=bucket_cap, dup_cap=dup_cap)
+                                 bucket_cap=bucket_cap, dup_cap=dup_cap,
+                                 eps=eps)
         h, z, diag = fn(*args)
         diag = np.asarray(diag)
         if mesh is not None and (diag[0] > 0 or diag[1] > 0):

@@ -647,12 +647,20 @@ def host_recheck_fn(idx: DensePIPIndex):
     entry = np.asarray(idx.entry)
     Z = int(idx.gzones.shape[1])
     # native-kernel tables, prepared ONCE at bind time (per-call work
-    # must scale with the flagged subset, not the chip-edge pool)
-    flat_native = np.ascontiguousarray(
-        np.concatenate([aux["flat_a"], aux["flat_b"]], axis=1))
-    ezslot_native = aux["edge_zslot"].astype(np.int32)
-    gzones_native = np.ascontiguousarray(
-        aux["gzones64"].astype(np.int32))
+    # must scale with the flagged subset, not the chip-edge pool) —
+    # and only when the native path can actually run
+    try:
+        from .. import native as _native
+    except ImportError:
+        _native = None
+    if _native is not None and (_native.get_lib() is None or Z > 16):
+        _native = None
+    if _native is not None:
+        flat_native = np.ascontiguousarray(
+            np.concatenate([aux["flat_a"], aux["flat_b"]], axis=1))
+        ezslot_native = aux["edge_zslot"].astype(np.int32)
+        gzones_native = np.ascontiguousarray(
+            aux["gzones64"].astype(np.int32))
 
     def recheck(points64: np.ndarray, zone: np.ndarray,
                 uncertain: np.ndarray) -> np.ndarray:
@@ -675,14 +683,10 @@ def host_recheck_fn(idx: DensePIPIndex):
         bsel = np.nonzero(isb)[0]
         if len(bsel):
             # native chip-parity core when the C++ layer is available
-            try:
-                from .. import native
-            except ImportError:
-                native = None
-            if native is not None:
+            if _native is not None:
                 grp = np.full(len(sel), -1, np.int64)
                 grp[bsel] = e[bsel]
-                nz = native.recheck_zones(
+                nz = _native.recheck_zones(
                     pts, grp, flat_native, ezslot_native,
                     aux["gstart"], gzones_native)
                 if nz is not None:

@@ -108,3 +108,11 @@ def test_read_strategies(tmp_path, zones):
                                np.asarray(t.data))
     with pytest.raises(ValueError):
         read_gtiff_files([p], strategy="bogus")
+
+
+def test_call_by_name(zones):
+    mc = MosaicContext.build("H3")
+    area = mc.call("st_area", zones)
+    assert len(area) == len(zones)
+    with pytest.raises(ValueError):
+        mc.call("st_nonexistent", zones)
