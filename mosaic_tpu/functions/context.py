@@ -266,29 +266,18 @@ class MosaicContext(RasterFunctions):
     def st_distance(self, a: Geoms, b: Geoms) -> np.ndarray:
         """Pairwise (row i vs row i) planar distance (reference:
         ST_Distance).  Points inside polygons get distance 0."""
-        ea, eb = self._edges(a), self._edges(b)
-        if np.all(a.types == GeometryType.POINT):
+        if np.all(a.types == GeometryType.POINT) and \
+                not np.all(b.types == GeometryType.POINT):
+            eb = self._edges(b)
             pts = np.asarray(points_block(a, dtype=np.float64))
             d = np.asarray(_measures.distance_points_to_geoms(pts, eb))
             d = np.diagonal(d).copy()
             inside, _ = _predicates.points_in_polygons(pts, eb)
             d[np.asarray(inside).diagonal()] = 0.0
             return d
-        # general: min over vertex-to-edge distances both directions
-        pa = a.coords[:, :2]
-        pb = b.coords[:, :2]
-        da = np.asarray(_measures.distance_points_to_geoms(
-            np.asarray(pa), eb))      # [Va, Gb]
-        db = np.asarray(_measures.distance_points_to_geoms(
-            np.asarray(pb), ea))      # [Vb, Ga]
-        ga = a.vertex_geom_ids()
-        gb = b.vertex_geom_ids()
-        out = np.full(len(a), np.inf)
-        for i in range(len(a)):
-            d1 = da[ga == i, i].min(initial=np.inf)
-            d2 = db[gb == i, i].min(initial=np.inf)
-            out[i] = min(d1, d2)
-        return out
+        # general: exact pairwise distance (0 for intersecting /
+        # nested geometries, else min vertex-to-segment both ways)
+        return _measures.pairwise_geometry_distance(a, b)
 
     # ------------------------------------------------------------------
     # predicates

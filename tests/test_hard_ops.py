@@ -241,3 +241,45 @@ class TestTriangulate:
         q = ctx.st_point([5.0], [5.0])
         z = ctx.st_interpolateelevation(mass, q)
         assert z[0] == pytest.approx(11.0)
+
+
+def test_st_distance_nested_and_crossing():
+    """ST_Distance must be 0 for intersecting AND nested geometries
+    (regression: the vertex-only formulation returned a positive
+    distance for a polygon strictly inside another)."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.build("H3")
+    outer = read_wkt(["POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))",
+                      "POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))",
+                      "POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))"])
+    inner = read_wkt(["POLYGON((4 4, 6 4, 6 6, 4 6, 4 4))",   # nested
+                      "POLYGON((8 8, 12 8, 12 12, 8 12, 8 8))",  # crossing
+                      "POLYGON((6 6, 8 6, 8 8, 6 8, 6 6))"])  # disjoint
+    d = mc.st_distance(outer, inner)
+    assert d[0] == 0.0
+    assert d[1] == 0.0
+    assert d[2] == pytest.approx(np.hypot(2, 2))
+
+
+def test_st_distance_mixed_types_and_multipart():
+    """Mixed POINT rows and nested multipolygon components (review
+    repro regressions)."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.build("H3")
+    a = read_wkt(["POLYGON((0 0, 1 0, 1 1, 0 1, 0 0))",
+                  "POINT(5 5)",
+                  "MULTIPOLYGON(((100 100, 101 100, 101 101, 100 101,"
+                  " 100 100)), ((4 4, 6 4, 6 6, 4 6, 4 4)))"])
+    b = read_wkt(["POLYGON((3 0, 4 0, 4 1, 3 1, 3 0))",
+                  "POLYGON((7 5, 9 5, 9 7, 7 7, 7 5))",
+                  "POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))"])
+    d = mc.st_distance(a, b)
+    assert d[0] == pytest.approx(2.0)
+    assert d[1] == pytest.approx(2.0)
+    assert d[2] == 0.0                 # nested second component
+    # point vs point
+    p1 = read_wkt(["POINT(0 0)"])
+    p2 = read_wkt(["POINT(3 4)"])
+    assert mc.st_distance(p1, p2)[0] == pytest.approx(5.0)
