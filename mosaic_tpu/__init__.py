@@ -32,6 +32,7 @@ from .core.geometry.geojson import read_geojson, write_geojson
 from .core.index.factory import get_index_system
 from .core.tessellate import tessellate, polyfill, point_chips
 from .types import ChipSet
+from .sql import SQLSession, prettified
 
 __version__ = "0.1.0"
 

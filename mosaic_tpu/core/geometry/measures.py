@@ -144,9 +144,9 @@ def pairwise_geometry_distance(a, b) -> "np.ndarray":
     g = len(a)
     out = np.full(g, np.inf)
 
-    def seg_point_d(p, s1, s2, smask):
+    def seg_point_d(p, s1, s2):
         # p [P, 2]; s1/s2 [E, 2] -> min distance point->segments
-        if not len(p) or not smask.any():
+        if not len(p) or not len(s1):
             return np.inf
         d = s2 - s1                                  # [E, 2]
         ap = p[:, None, :] - s1[None]                # [P, E, 2]
@@ -154,10 +154,12 @@ def pairwise_geometry_distance(a, b) -> "np.ndarray":
         t = np.clip(np.sum(ap * d[None], -1) / denom, 0.0, 1.0)
         proj = s1[None] + t[..., None] * d[None]
         dd = np.linalg.norm(p[:, None] - proj, axis=-1)
-        dd = np.where(smask[None], dd, np.inf)
         return dd.min(initial=np.inf)
 
-    def crossing_any(p1, p2, m1, q1, q2, m2):
+    def crossing_any(p1, p2, q1, q2):
+        if not len(p1) or not len(q1):
+            return False
+
         def orient(p, q, r):
             return (q[..., 0] - p[..., 0]) * (r[..., 1] - p[..., 1]) - \
                    (q[..., 1] - p[..., 1]) * (r[..., 0] - p[..., 0])
@@ -170,11 +172,12 @@ def pairwise_geometry_distance(a, b) -> "np.ndarray":
         d3 = orient(a1, b1, a2)
         d4 = orient(a1, b1, b2)
         proper = ((d1 > 0) != (d2 > 0)) & ((d3 > 0) != (d4 > 0))
-        return bool(np.any(proper & m1[:, None] & m2[None]))
+        return bool(np.any(proper))
 
-    def pip_any(pts, s1, s2, smask):
-        # any of pts inside the (multi)polygon edge soup, crossing rule
-        if not len(pts) or not smask.any():
+    def pip_any(pts, s1, s2):
+        # any of pts inside the closed-ring edge set, crossing rule
+        # (only valid over closed rings — open segments break parity)
+        if not len(pts) or not len(s1):
             return False
         straddle = (s1[None, :, 1] <= pts[:, 1:2]) != \
             (s2[None, :, 1] <= pts[:, 1:2])
@@ -183,8 +186,35 @@ def pairwise_geometry_distance(a, b) -> "np.ndarray":
                 s2[None, :, 1] == s1[None, :, 1], 1.0,
                 s2[None, :, 1] - s1[None, :, 1])
         xi = s1[None, :, 0] + t * (s2[None, :, 0] - s1[None, :, 0])
-        hits = straddle & (pts[:, 0:1] < xi) & smask[None]
+        hits = straddle & (pts[:, 0:1] < xi)
         return bool(np.any(np.sum(hits, axis=1) & 1))
+
+    def closed_ring_edges(arr, i):
+        """Edges of rows' closed rings only: every POLYGON/MULTIPOLYGON
+        ring, plus explicitly closed >=4-vertex rings of collections.
+        Open linestring parts are excluded — crossing-parity PIP is
+        undefined over them (a lone crossing would read as 'inside')."""
+        t = arr.geom_type(i)
+        explicit_only = t == GeometryType.GEOMETRYCOLLECTION
+        _, parts = arr.geom_slices(i)
+        s1s, s2s = [], []
+        for part in parts:
+            for ring in part:
+                r = np.asarray(ring, np.float64)[:, :2]
+                if len(r) < 3:
+                    continue
+                closed = np.array_equal(r[0], r[-1])
+                if explicit_only and not closed:
+                    continue
+                body = r[:-1] if closed else r
+                if len(body) < 3:
+                    continue
+                s1s.append(body)
+                s2s.append(np.roll(body, -1, axis=0))
+        if not s1s:
+            z = np.zeros((0, 2))
+            return z, z
+        return np.vstack(s1s), np.vstack(s2s)
 
     def row_vertices(arr, i):
         _, parts = arr.geom_slices(i)
@@ -199,25 +229,26 @@ def pairwise_geometry_distance(a, b) -> "np.ndarray":
     poly_t = (GeometryType.POLYGON, GeometryType.MULTIPOLYGON,
               GeometryType.GEOMETRYCOLLECTION)
     for i in range(g):
-        ma, mb = MA[i], MB[i]
+        ea1, ea2 = A1[i][MA[i]], A2[i][MA[i]]     # valid edges only —
+        eb1, eb2 = B1[i][MB[i]], B2[i][MB[i]]     # no capacity-wide math
         va, ra = row_vertices(a, i)
         vb, rb = row_vertices(b, i)
         if not len(va) or not len(vb):
             out[i] = np.nan                  # empty geometry
             continue
-        if ma.any() and mb.any() and \
-                crossing_any(A1[i], A2[i], ma, B1[i], B2[i], mb):
+        if crossing_any(ea1, ea2, eb1, eb2):
             out[i] = 0.0
             continue
-        # per-part representative containment (nested components)
+        # per-part representative containment (nested components),
+        # tested against closed rings only
         if (b.geom_type(i) in poly_t and
-                pip_any(ra, B1[i], B2[i], mb)) or \
+                pip_any(ra, *closed_ring_edges(b, i))) or \
                 (a.geom_type(i) in poly_t and
-                 pip_any(rb, A1[i], A2[i], ma)):
+                 pip_any(rb, *closed_ring_edges(a, i))):
             out[i] = 0.0
             continue
-        d1 = seg_point_d(va, B1[i], B2[i], mb)
-        d2 = seg_point_d(vb, A1[i], A2[i], ma)
+        d1 = seg_point_d(va, eb1, eb2)
+        d2 = seg_point_d(vb, ea1, ea2)
         best = min(d1, d2)
         if not np.isfinite(best):            # point vs point rows
             dd = np.linalg.norm(va[:, None] - vb[None], axis=-1)

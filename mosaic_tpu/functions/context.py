@@ -265,9 +265,13 @@ class MosaicContext(RasterFunctions):
 
     def st_distance(self, a: Geoms, b: Geoms) -> np.ndarray:
         """Pairwise (row i vs row i) planar distance (reference:
-        ST_Distance).  Points inside polygons get distance 0."""
-        if np.all(a.types == GeometryType.POINT) and \
-                not np.all(b.types == GeometryType.POINT):
+        ST_Distance).  Points inside polygons get distance 0.  The fast
+        path needs every b row to be a closed-ring geometry (edge-less
+        POINT/MULTIPOINT rows would read as infinitely far; open
+        linestrings break the crossing-parity containment test)."""
+        b_all_poly = np.all(np.isin(
+            b.types, (GeometryType.POLYGON, GeometryType.MULTIPOLYGON)))
+        if np.all(a.types == GeometryType.POINT) and b_all_poly:
             eb = self._edges(b)
             pts = np.asarray(points_block(a, dtype=np.float64))
             d = np.asarray(_measures.distance_points_to_geoms(pts, eb))
@@ -659,6 +663,26 @@ class MosaicContext(RasterFunctions):
         inner = self.grid_geometrykring(g, res, k - 1) if k > 1 else \
             [c for c in self.grid_polyfill_union(g, res)]
         return [np.setdiff1d(o, i) for o, i in zip(outer, inner)]
+
+    @staticmethod
+    def _explode_lists(lists):
+        """Flatten per-row cell arrays into (source row, cell id) pairs."""
+        src = np.repeat(np.arange(len(lists)),
+                        [len(r) for r in lists]).astype(np.int64)
+        cells = (np.concatenate(lists) if lists else
+                 np.empty(0, np.int64)).astype(np.int64)
+        return src, cells
+
+    def grid_geometrykringexplode(self, g: Geoms, res: int, k: int):
+        """Exploded geometry k-ring: (source row, cell id) pairs
+        (reference: GeometryKRingExplode, functions/MosaicContext.scala
+        grid_geometrykringexplode registration)."""
+        return self._explode_lists(self.grid_geometrykring(g, res, k))
+
+    def grid_geometrykloopexplode(self, g: Geoms, res: int, k: int):
+        """Exploded geometry k-loop (hollow ring) — reference:
+        GeometryKLoopExplode."""
+        return self._explode_lists(self.grid_geometrykloop(g, res, k))
 
     def grid_polyfill_union(self, g: Geoms, res: int) -> List[np.ndarray]:
         chips = tessellate(g, res, self.index_system, keep_core_geom=False)

@@ -1,0 +1,14 @@
+"""SQL surface: parser + columnar engine + prettifier.
+
+Reference counterpart: mosaic/sql/ (MosaicSQL/MosaicSQLDefault
+SparkSessionExtensions, Prettifier, MosaicAnalyzer).  The analyzer lives
+at :mod:`mosaic_tpu.analyzer`; this package supplies the query engine the
+reference gets for free from Spark.
+"""
+
+from .engine import SQLError, SQLSession, Table
+from .parser import SQLParseError, parse
+from .prettifier import prettified
+
+__all__ = ["SQLSession", "Table", "SQLError", "SQLParseError", "parse",
+           "prettified"]

@@ -1,0 +1,397 @@
+"""Columnar SQL engine over the registered function surface.
+
+Reference counterpart: sql/extensions/MosaicSQL.scala:21-47 (+
+MosaicSQLDefault) expose every registered expression to Spark SQL; the
+Quickstart notebook's PIP-join is written in exactly the query shapes this
+engine executes:
+
+    points  = SELECT *, grid_pointascellid(geom, 9) AS cell FROM trips
+    chips   = SELECT zone_id, grid_tessellateexplode(geom, 9) FROM zones
+    joined  = SELECT ... FROM points JOIN chips ON points.cell = chips.index_id
+              WHERE is_core OR st_contains(wkb, geom)
+
+Tables are dicts of equal-length columns; a column is a numpy array, a
+``GeometryArray``, or a python list (e.g. WKB bytes).  Function calls
+dispatch by name through ``MosaicContext.call`` — the same string-dispatch
+boundary the reference's SQL registration uses — and evaluate columnar
+(row-wise semantics via equal-length vectorized kernels).
+
+Execution order: FROM/JOIN -> explode generator (if any select item is a
+generator call) -> WHERE -> GROUP BY/aggregate -> projection -> ORDER BY
+-> LIMIT.  WHERE runs after the explode so filters can reference the
+generated ``is_core``/``index_id``/``wkb`` columns, matching how the
+reference's users filter tessellations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.geometry.array import GeometryArray
+from .parser import (Binary, Call, Column, Literal, Query, SelectItem,
+                     Star, Unary, parse)
+
+GENERATORS = {"grid_tessellateexplode", "mosaic_explode",
+              "grid_cellkringexplode", "grid_cellkloopexplode",
+              "grid_geometrykringexplode", "grid_geometrykloopexplode"}
+
+AGGREGATES = {"count", "sum", "avg", "mean", "min", "max", "first"}
+
+
+class SQLError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------- columns
+
+def col_len(col) -> int:
+    return len(col)
+
+
+def col_take(col, idx: np.ndarray):
+    if isinstance(col, GeometryArray):
+        return col.take(idx)
+    if isinstance(col, np.ndarray):
+        return col[idx]
+    return [col[int(i)] for i in idx]
+
+
+def _as_mask(col, n: int) -> np.ndarray:
+    m = np.asarray(col)
+    if m.shape == ():
+        m = np.full(n, bool(m))
+    return m.astype(bool)
+
+
+class Table:
+    """Ordered named columns of equal length."""
+
+    def __init__(self, columns: Dict[str, object]):
+        self.columns = dict(columns)
+        lens = {col_len(c) for c in self.columns.values()}
+        if len(lens) > 1:
+            raise SQLError(f"ragged columns: "
+                           f"{ {k: col_len(v) for k, v in columns.items()} }")
+        self._n = lens.pop() if lens else 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def column(self, name: str):
+        if name not in self.columns:
+            raise SQLError(f"no column {name!r}; have "
+                           f"{list(self.columns)}")
+        return self.columns[name]
+
+    def take(self, idx: np.ndarray) -> "Table":
+        return Table({k: col_take(v, idx) for k, v in self.columns.items()})
+
+    def head(self, n: int) -> "Table":
+        return self.take(np.arange(min(n, self._n)))
+
+    def to_dict(self) -> Dict[str, object]:
+        return dict(self.columns)
+
+    def __repr__(self) -> str:
+        return (f"Table[{self._n} rows x {len(self.columns)} cols: "
+                f"{list(self.columns)}]")
+
+
+# ---------------------------------------------------------- evaluation
+
+class _Env:
+    """Column resolution over one or two (joined) tables."""
+
+    def __init__(self, tables: Dict[str, Table]):
+        self.tables = tables            # qualifier -> Table
+
+    def resolve(self, name: str, qualifier: Optional[str]):
+        if qualifier is not None:
+            if qualifier not in self.tables:
+                raise SQLError(f"unknown table qualifier {qualifier!r}")
+            return self.tables[qualifier].column(name)
+        hits = [(q, t) for q, t in self.tables.items()
+                if name in t.columns]
+        if not hits:
+            raise SQLError(f"no column {name!r} in "
+                           f"{[list(t.columns) for t in self.tables.values()]}")
+        if len({id(t) for _, t in hits}) > 1:
+            raise SQLError(f"ambiguous column {name!r} "
+                           f"(in {[q for q, _ in hits]})")
+        return hits[0][1].column(name)
+
+
+def _numeric(x):
+    if isinstance(x, list):
+        return np.asarray(x)
+    return x
+
+
+class SQLSession:
+    """Named tables + query execution (reference: the SparkSession the
+    MosaicSQL extension installs into)."""
+
+    def __init__(self, context=None):
+        from ..functions.context import MosaicContext
+        self.mc = context or MosaicContext.context()
+        self._tables: Dict[str, Table] = {}
+
+    # -- catalog
+    def create_table(self, name: str, columns: Dict[str, object]) -> Table:
+        t = Table(columns)
+        self._tables[name.lower()] = t
+        return t
+
+    def table(self, name: str) -> Table:
+        key = name.lower()
+        if key not in self._tables:
+            raise SQLError(f"unknown table {name!r}")
+        return self._tables[key]
+
+    def drop_table(self, name: str) -> None:
+        self._tables.pop(name.lower(), None)
+
+    # -- query entry
+    def sql(self, query: str) -> Table:
+        q = parse(query)
+        base_env, row_order = self._from_clause(q)
+        # explode generators before WHERE so filters see generated cols
+        env, gen_items = self._apply_generators(q, base_env)
+        if q.where is not None:
+            n = self._env_len(env)
+            mask = _as_mask(self._eval(q.where, env), n)
+            env = self._take_env(env, np.flatnonzero(mask))
+        if q.group_by is not None or self._has_aggregate(q.items):
+            out = self._aggregate(q, env, gen_items)
+        else:
+            out = self._project(q.items, env, gen_items)
+        if q.order_by:
+            keys = []
+            for e, desc in reversed(q.order_by):
+                k = np.asarray(_numeric(self._eval(e, _Env({"_t": out}))))
+                if not np.issubdtype(k.dtype, np.number):
+                    # rank-encode so lexsort and DESC negation apply
+                    _, k = np.unique(k, return_inverse=True)
+                keys.append(-k if desc else k)
+            idx = np.lexsort(keys)
+            out = out.take(idx)
+        if q.limit is not None:
+            out = out.head(q.limit)
+        return out
+
+    # -- FROM / JOIN
+    def _from_clause(self, q: Query):
+        left = self.table(q.table.name)
+        lq = (q.table.alias or q.table.name).lower()
+        if q.join is None:
+            return _Env({lq: left}), None
+        right = self.table(q.join.name)
+        rq = (q.join.alias or q.join.name).lower()
+        li, ri = self._equi_join(left, lq, right, rq, q.join_on)
+        jl, jr = left.take(li), right.take(ri)
+        return _Env({lq: jl, rq: jr}), None
+
+    def _equi_join(self, left, lq, right, rq, on):
+        """Hash join on a conjunction of equality predicates."""
+        conjuncts: List = []
+
+        def flat(e):
+            if isinstance(e, Binary) and e.op == "and":
+                flat(e.left)
+                flat(e.right)
+            else:
+                conjuncts.append(e)
+
+        flat(on)
+        lkeys, rkeys = [], []
+        for c in conjuncts:
+            if not (isinstance(c, Binary) and c.op == "="):
+                raise SQLError("JOIN ON supports conjunctions of "
+                               "equalities only")
+            le, re = c.left, c.right
+            lv = self._try_eval(le, _Env({lq: left}))
+            if lv is None:                 # sides written right = left
+                le, re = re, le
+                lv = self._try_eval(le, _Env({lq: left}))
+            rv = self._try_eval(re, _Env({rq: right}))
+            if lv is None or rv is None:
+                raise SQLError("each JOIN equality must reference one "
+                               "table per side")
+            lkeys.append(np.asarray(_numeric(lv)))
+            rkeys.append(np.asarray(_numeric(rv)))
+        # composite key -> dict of right-row lists
+        rmap: Dict[object, List[int]] = {}
+        for j in range(len(right)):
+            k = tuple(rk[j] for rk in rkeys)
+            rmap.setdefault(k, []).append(j)
+        li, ri = [], []
+        for i in range(len(left)):
+            k = tuple(lk[i] for lk in lkeys)
+            for j in rmap.get(k, ()):
+                li.append(i)
+                ri.append(j)
+        return np.asarray(li, np.int64), np.asarray(ri, np.int64)
+
+    @staticmethod
+    def _take_env(env: "_Env", idx: np.ndarray) -> "_Env":
+        return _Env({qn: t.take(idx) for qn, t in env.tables.items()})
+
+    def _try_eval(self, e, env):
+        try:
+            return self._eval(e, env)
+        except SQLError:
+            return None
+
+    # -- generators (explode)
+    def _apply_generators(self, q: Query, env: _Env):
+        gens = [it for it in q.items
+                if isinstance(it.expr, Call) and it.expr.name in GENERATORS]
+        if not gens:
+            return env, {}
+        if len(gens) > 1:
+            raise SQLError("only one generator per SELECT "
+                           "(reference: Spark's Generate operator)")
+        it = gens[0]
+        call = it.expr
+        args = [self._eval(a, env) for a in call.args]
+        name = call.name
+        if name in ("grid_tessellateexplode", "mosaic_explode"):
+            chips = self.mc.call(name, *args)
+            src = chips.geom_id
+            gcols = {"is_core": chips.is_core.copy(),
+                     "index_id": chips.cell_id.copy(),
+                     "wkb": chips.geoms}
+        else:
+            src, cells = self.mc.call(name, *args)
+            gcols = {(it.alias or "cellid"): cells}
+        src = np.asarray(src, np.int64)
+        env2 = _Env({qn: t.take(src) for qn, t in env.tables.items()})
+        gtab = Table(gcols)
+        env2.tables["#gen"] = gtab
+        return env2, {id(call): gtab}
+
+    # -- aggregation
+    def _has_aggregate(self, items: Sequence[SelectItem]) -> bool:
+        return any(isinstance(it.expr, Call) and
+                   it.expr.name in AGGREGATES for it in items)
+
+    def _aggregate(self, q: Query, env: _Env, gen_items) -> Table:
+        n = self._env_len(env)
+        if q.group_by:
+            gkeys = [np.asarray(_numeric(self._eval(e, env)))
+                     for e in q.group_by]
+            key_rows = list(zip(*[k.tolist() for k in gkeys])) \
+                if n else []
+            seen: Dict[object, int] = {}
+            gid = np.empty(n, np.int64)
+            for i, k in enumerate(key_rows):
+                gid[i] = seen.setdefault(k, len(seen))
+            ngroups = len(seen)
+            group_idx = [np.flatnonzero(gid == g) for g in range(ngroups)]
+        else:
+            group_idx = [np.arange(n)]
+        cols: Dict[str, object] = {}
+        for pos, it in enumerate(q.items):
+            name = it.alias or self._default_name(it.expr, pos)
+            e = it.expr
+            if isinstance(e, Call) and e.name in AGGREGATES:
+                cols[name] = self._agg_call(e, env, group_idx)
+            else:
+                # must be (equal to) a grouping expression: take first
+                vals = self._eval(e, env)
+                firsts = np.asarray([g[0] for g in group_idx], np.int64)
+                cols[name] = col_take(vals, firsts)
+        return Table(cols)
+
+    def _agg_call(self, e: Call, env: _Env, group_idx):
+        if e.name == "count":
+            return np.asarray([len(g) for g in group_idx], np.int64)
+        if len(e.args) != 1:
+            raise SQLError(f"{e.name} takes one argument")
+        vals = np.asarray(_numeric(self._eval(e.args[0], env)))
+        fn = {"sum": np.sum, "avg": np.mean, "mean": np.mean,
+              "min": np.min, "max": np.max,
+              "first": lambda v: v[0]}[e.name]
+        return np.asarray([fn(vals[g]) if len(g) else np.nan
+                           for g in group_idx])
+
+    # -- projection
+    def _project(self, items, env: _Env, gen_items) -> Table:
+        cols: Dict[str, object] = {}
+        for pos, it in enumerate(items):
+            if isinstance(it.expr, Star):
+                for qn, t in env.tables.items():
+                    if qn == "#gen":
+                        continue
+                    for cname, c in t.columns.items():
+                        cols[cname if cname not in cols
+                             else f"{qn}.{cname}"] = c
+                if "#gen" in env.tables:
+                    cols.update(env.tables["#gen"].columns)
+                continue
+            if isinstance(it.expr, Call) and id(it.expr) in gen_items:
+                cols.update(gen_items[id(it.expr)].columns)
+                continue
+            name = it.alias or self._default_name(it.expr, pos)
+            cols[name] = self._eval(it.expr, env)
+        return Table(cols)
+
+    @staticmethod
+    def _default_name(e, pos: int) -> str:
+        if isinstance(e, Column):
+            return e.name
+        if isinstance(e, Call):
+            return e.name
+        return f"col{pos}"
+
+    # -- expression evaluation
+    def _env_len(self, env: _Env) -> int:
+        for t in env.tables.values():
+            return len(t)
+        return 0
+
+    def _eval(self, e, env: _Env):
+        if isinstance(e, Literal):
+            return e.value
+        if isinstance(e, Column):
+            return env.resolve(e.name, e.table)
+        if isinstance(e, Unary):
+            v = self._eval(e.operand, env)
+            if e.op == "-":
+                return -np.asarray(_numeric(v))
+            if e.op == "not":
+                return ~_as_mask(v, self._env_len(env))
+            a = np.asarray(
+                [x is None or (isinstance(x, float) and np.isnan(x))
+                 for x in (v if isinstance(v, list) else
+                           np.asarray(v).tolist())])
+            return a if e.op == "isnull" else ~a
+        if isinstance(e, Binary):
+            n = self._env_len(env)
+            if e.op in ("and", "or"):
+                a = _as_mask(self._eval(e.left, env), n)
+                b = _as_mask(self._eval(e.right, env), n)
+                return (a & b) if e.op == "and" else (a | b)
+            a = self._eval(e.left, env)
+            b = self._eval(e.right, env)
+            a, b = _numeric(a), _numeric(b)
+            import operator as op_
+            fn = {"+": op_.add, "-": op_.sub, "*": op_.mul,
+                  "/": op_.truediv, "%": op_.mod,
+                  "=": op_.eq, "!=": op_.ne, "<": op_.lt,
+                  "<=": op_.le, ">": op_.gt, ">=": op_.ge}[e.op]
+            return fn(a, b)
+        if isinstance(e, Call):
+            if e.name in GENERATORS:
+                raise SQLError(f"{e.name} is a generator — use it as a "
+                               "top-level SELECT item")
+            if e.name in AGGREGATES:
+                raise SQLError(f"{e.name} requires GROUP BY context")
+            args = [self._eval(a, env) for a in e.args]
+            try:
+                return self.mc.call(e.name, *args)
+            except ValueError:
+                raise SQLError(f"unknown function {e.name!r}")
+        raise SQLError(f"cannot evaluate {e!r}")

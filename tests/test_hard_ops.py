@@ -283,3 +283,31 @@ def test_st_distance_mixed_types_and_multipart():
     p1 = read_wkt(["POINT(0 0)"])
     p2 = read_wkt(["POINT(3 4)"])
     assert mc.st_distance(p1, p2)[0] == pytest.approx(5.0)
+
+
+def test_st_distance_mixed_point_rows():
+    """Fast path must not claim inf for POINT rows on the right side
+    (review finding: all-POINT left x mixed right)."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.context()
+    a = read_wkt(["POINT (0 0)", "POINT (1 1)"])
+    b = read_wkt(["POLYGON ((2 0, 3 0, 3 1, 2 1, 2 0))", "POINT (4 5)"])
+    d = mc.st_distance(a, b)
+    assert d[0] == pytest.approx(2.0)
+    assert d[1] == pytest.approx(5.0)
+
+
+def test_st_distance_collection_open_linestring():
+    """Open linestring in a GEOMETRYCOLLECTION must not read as a filled
+    region (crossing-parity only holds over closed rings)."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.context()
+    a = read_wkt(["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"])
+    b = read_wkt(["GEOMETRYCOLLECTION (LINESTRING (5 -5, 5 15))"])
+    d = mc.st_distance(a, b)
+    assert d[0] == pytest.approx(4.0)
+    # and the symmetric direction
+    d2 = mc.st_distance(b, a)
+    assert d2[0] == pytest.approx(4.0)

