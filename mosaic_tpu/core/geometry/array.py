@@ -70,6 +70,11 @@ class GeometryArray:
     geom_offsets: np.ndarray  # [G+1] int64
     types: np.ndarray         # [G] uint8
     srid: int = 4326
+    # [P] uint8 member types — only meaningful for GEOMETRYCOLLECTION
+    # rows, whose parts would otherwise lose their sub-geometry type in
+    # the flattened layout (a closed LINESTRING member must not read as
+    # a filled POLYGON).  None = derive from the row type.
+    part_types: "np.ndarray | None" = None
 
     # ---------------------------------------------------------- invariants
     def __post_init__(self):
@@ -80,6 +85,8 @@ class GeometryArray:
         self.part_offsets = np.asarray(self.part_offsets, dtype=np.int64)
         self.geom_offsets = np.asarray(self.geom_offsets, dtype=np.int64)
         self.types = np.asarray(self.types, dtype=np.uint8)
+        if self.part_types is not None:
+            self.part_types = np.asarray(self.part_types, dtype=np.uint8)
 
     def validate(self) -> None:
         assert self.ring_offsets[0] == 0
@@ -175,12 +182,33 @@ class GeometryArray:
             parts.extend((a.part_offsets[1:] + parts[-1]).tolist())
             geoms.extend((a.geom_offsets[1:] + geoms[-1]).tolist())
             types.append(a.types)
+        any_pt = any(a.part_types is not None for a in arrays)
         return GeometryArray(
             coords=np.concatenate(coords) if coords else np.zeros((0, ndim)),
             ring_offsets=np.asarray(rings, np.int64),
             part_offsets=np.asarray(parts, np.int64),
             geom_offsets=np.asarray(geoms, np.int64),
-            types=np.concatenate(types), srid=arrays[0].srid)
+            types=np.concatenate(types), srid=arrays[0].srid,
+            part_types=(np.concatenate([a.part_types_effective()
+                                        for a in arrays])
+                        if any_pt else None))
+
+    def part_types_effective(self) -> np.ndarray:
+        """[P] uint8 member type per part: the stored ``part_types`` when
+        present, else the row type broadcast to its parts (multis map to
+        their member type; collections without stored types stay
+        GEOMETRYCOLLECTION = "unknown member")."""
+        if self.part_types is not None:
+            return self.part_types
+        multi_to_single = {int(GeometryType.MULTIPOINT):
+                           int(GeometryType.POINT),
+                           int(GeometryType.MULTILINESTRING):
+                           int(GeometryType.LINESTRING),
+                           int(GeometryType.MULTIPOLYGON):
+                           int(GeometryType.POLYGON)}
+        per_geom = np.asarray([multi_to_single.get(int(t), int(t))
+                               for t in self.types], np.uint8)
+        return np.repeat(per_geom, np.diff(self.geom_offsets))
 
     # -------------------------------------------------------- python view
     def geom_slices(self, i: int) -> Tuple[GeometryType, List[List[np.ndarray]]]:
@@ -228,7 +256,9 @@ class GeometryArray:
         return GeometryArray(
             coords=self.coords[v_idx], ring_offsets=ring_offsets,
             part_offsets=part_offsets, geom_offsets=geom_offsets,
-            types=self.types[idx], srid=self.srid)
+            types=self.types[idx], srid=self.srid,
+            part_types=(self.part_types[p_idx]
+                        if self.part_types is not None else None))
 
     def __getitem__(self, i) -> "GeometryArray":
         if isinstance(i, (int, np.integer)):
@@ -290,10 +320,31 @@ class GeometryBuilder:
         self._parts = [0]
         self._geoms = [0]
         self._types: List[int] = []
+        self._part_types: List[int] = []
+        self._have_part_types = False
         self._nv = 0
 
     def add(self, gtype: GeometryType,
-            parts: Iterable[Iterable[np.ndarray]]) -> None:
+            parts: Iterable[Iterable[np.ndarray]],
+            part_types: "Iterable[int] | None" = None) -> None:
+        parts = list(parts)
+        if part_types is not None:
+            part_types = [int(t) for t in part_types]
+            if len(part_types) != len(parts):
+                raise ValueError(f"{len(part_types)} part types for "
+                                 f"{len(parts)} parts")
+            self._part_types.extend(part_types)
+            self._have_part_types = True
+        else:
+            # default: member type derived from the row type (multis map
+            # to their member; collections stay "unknown")
+            m2s = {int(GeometryType.MULTIPOINT): int(GeometryType.POINT),
+                   int(GeometryType.MULTILINESTRING):
+                   int(GeometryType.LINESTRING),
+                   int(GeometryType.MULTIPOLYGON):
+                   int(GeometryType.POLYGON)}
+            self._part_types.extend(
+                [m2s.get(int(gtype), int(gtype))] * len(parts))
         for rings in parts:
             for ring in rings:
                 ring = np.atleast_2d(np.asarray(ring, dtype=np.float64))
@@ -330,4 +381,6 @@ class GeometryBuilder:
             ring_offsets=np.asarray(self._rings, np.int64),
             part_offsets=np.asarray(self._parts, np.int64),
             geom_offsets=np.asarray(self._geoms, np.int64),
-            types=np.asarray(self._types, np.uint8), srid=self.srid)
+            types=np.asarray(self._types, np.uint8), srid=self.srid,
+            part_types=(np.asarray(self._part_types, np.uint8)
+                        if self._have_part_types else None))

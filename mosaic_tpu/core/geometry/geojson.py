@@ -40,11 +40,15 @@ def _add_geojson(obj: dict, builder: GeometryBuilder) -> None:
         for g in obj["geometries"]:
             _add_geojson(g, sub)
         arr = sub.finish()
-        parts = []
+        eff = arr.part_types_effective()
+        parts, ptypes = [], []
         for i in range(len(arr)):
             _, sp = arr.geom_slices(i)
             parts.extend(sp)
-        builder.add(GeometryType.GEOMETRYCOLLECTION, parts)
+            ptypes.extend(eff[arr.geom_offsets[i]:
+                              arr.geom_offsets[i + 1]].tolist())
+        builder.add(GeometryType.GEOMETRYCOLLECTION, parts,
+                    part_types=ptypes)
     elif t == "Feature":
         _add_geojson(obj["geometry"], builder)
     elif t == "FeatureCollection":
@@ -61,7 +65,7 @@ def read_geojson(texts: Sequence[str], srid: int = 4326) -> GeometryArray:
     return builder.finish()
 
 
-def _geom_to_obj(gtype: GeometryType, parts) -> dict:
+def _geom_to_obj(gtype: GeometryType, parts, part_types=None) -> dict:
     def rings(p):
         return [np.asarray(r).tolist() for r in p]
 
@@ -83,10 +87,11 @@ def _geom_to_obj(gtype: GeometryType, parts) -> dict:
     if gtype == GeometryType.MULTIPOLYGON:
         return {"type": "MultiPolygon", "coordinates": [rings(p) for p in parts]}
     if gtype == GeometryType.GEOMETRYCOLLECTION:
-        from .wkb import _infer_part_type
+        from .wkb import _member_type
         return {"type": "GeometryCollection",
-                "geometries": [_geom_to_obj(_infer_part_type(p), [p])
-                               for p in parts]}
+                "geometries": [_geom_to_obj(_member_type(p, part_types, j),
+                                            [p])
+                               for j, p in enumerate(parts)]}
     raise ValueError(gtype)
 
 
@@ -94,5 +99,7 @@ def write_geojson(arr: GeometryArray) -> List[str]:
     out = []
     for i in range(len(arr)):
         t, parts = arr.geom_slices(i)
-        out.append(json.dumps(_geom_to_obj(t, parts)))
+        pt = (arr.part_types[arr.geom_offsets[i]:arr.geom_offsets[i + 1]]
+              if arr.part_types is not None else None)
+        out.append(json.dumps(_geom_to_obj(t, parts, pt)))
     return out

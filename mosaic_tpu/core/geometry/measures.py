@@ -190,21 +190,29 @@ def pairwise_geometry_distance(a, b) -> "np.ndarray":
         return bool(np.any(np.sum(hits, axis=1) & 1))
 
     def closed_ring_edges(arr, i):
-        """Edges of rows' closed rings only: every POLYGON/MULTIPOLYGON
-        ring, plus explicitly closed >=4-vertex rings of collections.
-        Open linestring parts are excluded — crossing-parity PIP is
-        undefined over them (a lone crossing would read as 'inside')."""
-        t = arr.geom_type(i)
-        explicit_only = t == GeometryType.GEOMETRYCOLLECTION
+        """Edges of rows' FILLED rings only, for crossing-parity PIP:
+        rings whose member type is POLYGON/MULTIPOLYGON.  Linestring and
+        point members never contribute (a closed LINESTRING is a curve
+        with no interior — JTS distance semantics); unknown members
+        (legacy arrays without part_types) count only when explicitly
+        closed."""
+        eff = arr.part_types_effective()
+        p0 = int(arr.geom_offsets[i])
         _, parts = arr.geom_slices(i)
         s1s, s2s = [], []
-        for part in parts:
+        for k, part in enumerate(parts):
+            mt = GeometryType(int(eff[p0 + k]))
+            if mt in (GeometryType.POINT, GeometryType.MULTIPOINT,
+                      GeometryType.LINESTRING,
+                      GeometryType.MULTILINESTRING):
+                continue
+            unknown = mt == GeometryType.GEOMETRYCOLLECTION
             for ring in part:
                 r = np.asarray(ring, np.float64)[:, :2]
                 if len(r) < 3:
                     continue
                 closed = np.array_equal(r[0], r[-1])
-                if explicit_only and not closed:
+                if unknown and not closed:
                     continue
                 body = r[:-1] if closed else r
                 if len(body) < 3:

@@ -102,13 +102,17 @@ def _build_edges_np(arr: GeometryArray, capacity: Optional[int],
         p = ring_part[r]
         part_first_ring.setdefault(int(p), r)
 
+    ptypes = arr.part_types_effective()
     for r in range(arr.num_rings):
         v0, v1 = arr.ring_offsets[r], arr.ring_offsets[r + 1]
         ring = arr.coords[v0:v1, :2]
         if len(ring) == 0:
             continue
         gi = int(part_geom[ring_part[r]])
-        t = GeometryType(int(arr.types[gi]))
+        # classify by MEMBER type so collection linestring parts stay
+        # open; GEOMETRYCOLLECTION = unknown member (legacy arrays
+        # without part_types) keeps the close-if-ring behavior
+        t = GeometryType(int(ptypes[ring_part[r]]))
         is_poly = t in (GeometryType.POLYGON, GeometryType.MULTIPOLYGON,
                         GeometryType.GEOMETRYCOLLECTION) and len(ring) >= 3
         if is_poly:

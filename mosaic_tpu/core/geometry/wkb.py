@@ -122,11 +122,15 @@ def _read_geometry(cur: _Cursor, builder: GeometryBuilder,
         for _ in range(n):
             _read_geometry(cur, sub, srid_out)
         sub_arr = sub.finish()
-        parts = []
+        eff = sub_arr.part_types_effective()
+        parts, ptypes = [], []
         for i in range(len(sub_arr)):
             _, sub_parts = sub_arr.geom_slices(i)
             parts.extend(sub_parts)
-        builder.add(GeometryType.GEOMETRYCOLLECTION, parts)
+            ptypes.extend(eff[sub_arr.geom_offsets[i]:
+                              sub_arr.geom_offsets[i + 1]].tolist())
+        builder.add(GeometryType.GEOMETRYCOLLECTION, parts,
+                    part_types=ptypes)
     else:
         raise ValueError(f"unsupported WKB type {gtype}")
 
@@ -160,7 +164,7 @@ def _wkb_coords(arr: np.ndarray) -> bytes:
     return np.ascontiguousarray(arr, dtype="<f8").tobytes()
 
 
-def _write_one(gtype: GeometryType, parts, ndim: int) -> bytes:
+def _write_one(gtype: GeometryType, parts, ndim: int, part_types=None) -> bytes:
     z_flag = _ISO_Z if ndim == 3 else 0
     head = struct.pack("<BI", 1, int(gtype) + z_flag)
     body = b""
@@ -189,11 +193,22 @@ def _write_one(gtype: GeometryType, parts, ndim: int) -> bytes:
         # Members are re-emitted with inferred types: parts with 1-vertex
         # single ring → point; 1 ring open → linestring; else polygon.
         body = struct.pack("<I", len(parts))
-        for p in parts:
-            body += _write_one(_infer_part_type(p), [p], ndim)
+        for j, p in enumerate(parts):
+            body += _write_one(_member_type(p, part_types, j), [p], ndim)
     else:
         raise ValueError(gtype)
     return head + body
+
+
+def _member_type(rings, part_types, j) -> GeometryType:
+    """Member type for a collection part: the recorded type when the
+    array carries one (and it isn't the unknown-member sentinel), else
+    shape inference (legacy arrays built without part types)."""
+    if part_types is not None:
+        t = GeometryType(int(part_types[j]))
+        if t != GeometryType.GEOMETRYCOLLECTION:
+            return t
+    return _infer_part_type(rings)
 
 
 def _infer_part_type(rings) -> GeometryType:
@@ -211,5 +226,7 @@ def write_wkb(arr: GeometryArray) -> List[bytes]:
     out = []
     for i in range(len(arr)):
         t, parts = arr.geom_slices(i)
-        out.append(_write_one(t, parts, arr.ndim))
+        pt = (arr.part_types[arr.geom_offsets[i]:arr.geom_offsets[i + 1]]
+              if arr.part_types is not None else None)
+        out.append(_write_one(t, parts, arr.ndim, pt))
     return out

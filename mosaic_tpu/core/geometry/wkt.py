@@ -142,11 +142,14 @@ def _parse_geometry(p: _P, builder: GeometryBuilder):
             p.expect(")")
             break
         arr = sub.finish()
-        parts = []
+        eff = arr.part_types_effective()
+        parts, ptypes = [], []
         for i in range(len(arr)):
             _, sp = arr.geom_slices(i)
             parts.extend(sp)
-        builder.add(gtype, parts)
+            ptypes.extend(eff[arr.geom_offsets[i]:
+                              arr.geom_offsets[i + 1]].tolist())
+        builder.add(gtype, parts, part_types=ptypes)
 
 
 def _take_until_comma_or_close(p: _P) -> str:
@@ -202,7 +205,8 @@ def _coords_txt(arr: np.ndarray) -> str:
     return ", ".join(" ".join(_fmt(c) for c in row) for row in arr)
 
 
-def _write_one(gtype: GeometryType, parts, ndim: int) -> str:
+def _write_one(gtype: GeometryType, parts, ndim: int,
+               part_types=None) -> str:
     tag = gtype.wkt_name + (" Z" if ndim == 3 else "")
 
     def ring_set(rings):
@@ -229,9 +233,10 @@ def _write_one(gtype: GeometryType, parts, ndim: int) -> str:
         inner = ", ".join(ring_set(p) for p in parts)
         return f"{tag} ({inner})"
     if gtype == GeometryType.GEOMETRYCOLLECTION:
-        from .wkb import _infer_part_type
-        inner = ", ".join(_write_one(_infer_part_type(p), [p], ndim)
-                          for p in parts)
+        from .wkb import _member_type
+        inner = ", ".join(
+            _write_one(_member_type(p, part_types, j), [p], ndim)
+            for j, p in enumerate(parts))
         return f"{tag} ({inner})"
     raise ValueError(gtype)
 
@@ -240,5 +245,7 @@ def write_wkt(arr: GeometryArray) -> List[str]:
     out = []
     for i in range(len(arr)):
         t, parts = arr.geom_slices(i)
-        out.append(_write_one(t, parts, arr.ndim))
+        pt = (arr.part_types[arr.geom_offsets[i]:arr.geom_offsets[i + 1]]
+              if arr.part_types is not None else None)
+        out.append(_write_one(t, parts, arr.ndim, pt))
     return out

@@ -362,8 +362,11 @@ def map_algebra(tiles: Sequence[RasterTile],
     out = np.asarray(fn(*arrs))
     if out.ndim == 2:
         out = out[None]
+    # provenance stamp (reference: GDALCalc records last_command)
+    cmd = f"map_algebra({getattr(fn, '__name__', repr(fn))}, " \
+          f"{len(tiles)} tiles)"
     return RasterTile(out, tiles[0].gt, nodata=None, srid=tiles[0].srid,
-                      meta={"op": "map_algebra"})
+                      meta={"op": "map_algebra", "last_command": cmd})
 
 
 def resample(tile: RasterTile, factor_x: float,
@@ -466,7 +469,8 @@ def warp(tile: RasterTile, to_epsg: int,
         out = np.where(inb[None], out, fill)
     else:
         raise ValueError(f"unknown resample method {method!r}")
-    meta = dict(tile.meta, warped_from=str(tile.srid))
+    meta = dict(tile.meta, warped_from=str(tile.srid),
+                last_command=f"warp(to_epsg={to_epsg}, method={method})")
     return RasterTile(out, gt, nodata=tile.nodata if tile.nodata is not
                       None else np.nan, srid=to_epsg, meta=meta)
 

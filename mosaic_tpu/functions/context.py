@@ -78,10 +78,12 @@ class MosaicContext(RasterFunctions):
         registration path, sql/extensions/MosaicSQL.scala, where every
         function is reachable by name)."""
         from .registry import REGISTRY
+        from ..utils.trace import tracer
         if name not in REGISTRY:
             raise ValueError(f"unknown function {name!r} (see "
                              "function_names())")
-        return getattr(self, name)(*args, **kwargs)
+        with tracer.span(f"call/{name}"):
+            return getattr(self, name)(*args, **kwargs)
 
     def try_sql(self, fn, *args, **kwargs):
         """Null-on-error wrapper (reference:

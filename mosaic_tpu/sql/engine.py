@@ -156,7 +156,7 @@ class SQLSession:
     # -- query entry
     def sql(self, query: str) -> Table:
         q = parse(query)
-        base_env, row_order = self._from_clause(q)
+        base_env = self._from_clause(q)
         # explode generators before WHERE so filters see generated cols
         env, gen_items = self._apply_generators(q, base_env)
         if q.where is not None:
@@ -182,16 +182,19 @@ class SQLSession:
         return out
 
     # -- FROM / JOIN
-    def _from_clause(self, q: Query):
+    def _from_clause(self, q: Query) -> _Env:
         left = self.table(q.table.name)
         lq = (q.table.alias or q.table.name).lower()
         if q.join is None:
-            return _Env({lq: left}), None
+            return _Env({lq: left})
         right = self.table(q.join.name)
         rq = (q.join.alias or q.join.name).lower()
+        if lq == rq:
+            raise SQLError(f"self-join needs distinct aliases "
+                           f"(both sides are {lq!r})")
         li, ri = self._equi_join(left, lq, right, rq, q.join_on)
         jl, jr = left.take(li), right.take(ri)
-        return _Env({lq: jl, rq: jr}), None
+        return _Env({lq: jl, rq: jr})
 
     def _equi_join(self, left, lq, right, rq, on):
         """Hash join on a conjunction of equality predicates."""
@@ -389,9 +392,10 @@ class SQLSession:
                                "top-level SELECT item")
             if e.name in AGGREGATES:
                 raise SQLError(f"{e.name} requires GROUP BY context")
+            from ..functions.registry import REGISTRY
+            if e.name not in REGISTRY:     # pre-dispatch check so real
+                raise SQLError(            # function errors surface as-is
+                    f"unknown function {e.name!r}")
             args = [self._eval(a, env) for a in e.args]
-            try:
-                return self.mc.call(e.name, *args)
-            except ValueError:
-                raise SQLError(f"unknown function {e.name!r}")
+            return self.mc.call(e.name, *args)
         raise SQLError(f"cannot evaluate {e!r}")

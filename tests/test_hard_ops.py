@@ -311,3 +311,53 @@ def test_st_distance_collection_open_linestring():
     # and the symmetric direction
     d2 = mc.st_distance(b, a)
     assert d2[0] == pytest.approx(4.0)
+
+
+def test_st_distance_closed_linestring_in_collection():
+    """A closed LINESTRING member is a curve, not a surface: a point
+    inside the loop is 5 away (JTS semantics), not 0 (review finding:
+    part types were lost in the flattened collection layout)."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.context()
+    pt = read_wkt(["POINT (5 5)"])
+    loop = read_wkt(
+        ["GEOMETRYCOLLECTION (LINESTRING (0 0, 10 0, 10 10, 0 10, 0 0))"])
+    assert mc.st_distance(pt, loop)[0] == pytest.approx(5.0)
+    # a POLYGON member with the same shell IS filled
+    filled = read_wkt(
+        ["GEOMETRYCOLLECTION (POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))"])
+    assert mc.st_distance(pt, filled)[0] == 0.0
+
+
+def test_collection_member_types_round_trip():
+    """Collection member types survive WKT/WKB/GeoJSON round trips
+    (the writers used to re-infer, closing linestring loops into
+    polygons)."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt, write_wkt
+    from mosaic_tpu.core.geometry.wkb import read_wkb, write_wkb
+    from mosaic_tpu.core.geometry.geojson import (read_geojson,
+                                                  write_geojson)
+    src = "GEOMETRYCOLLECTION (LINESTRING (0 0, 10 0, 10 10, 0 10, 0 0)," \
+          " POINT (1 1), POLYGON ((2 2, 3 2, 3 3, 2 3, 2 2)))"
+    g = read_wkt([src])
+    out = write_wkt(g)[0]
+    assert "LINESTRING" in out and "POINT" in out and "POLYGON" in out
+    g2 = read_wkb(write_wkb(g))
+    assert "LINESTRING" in write_wkt(g2)[0]
+    g3 = read_geojson(write_geojson(g))
+    assert "LINESTRING" in write_wkt(g3)[0]
+    # take/concat preserve member types
+    from mosaic_tpu.core.geometry.array import GeometryArray
+    cat = GeometryArray.concat([g, g])
+    assert "LINESTRING" in write_wkt(cat.take(np.asarray([1])))[0]
+
+
+def test_st_length_collection_linestring():
+    """Collection linestring members must not gain a closing edge."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.context()
+    g = read_wkt(["GEOMETRYCOLLECTION (LINESTRING (0 0, 10 0, 10 10))"])
+    plain = read_wkt(["LINESTRING (0 0, 10 0, 10 10)"])
+    assert mc.st_length(g)[0] == pytest.approx(mc.st_length(plain)[0])

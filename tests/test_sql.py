@@ -186,4 +186,22 @@ def test_geometry_kring_explode_functions(mc):
     loops_src, loops = mc.grid_geometrykloopexplode(g, 3, 2)
     ring1 = set(cells[src == 0].tolist())
     loop2 = set(loops[loops_src == 0].tolist())
-    assert not (ring1 & loop2) or True  # loop excludes interior ring
+    assert ring1.isdisjoint(loop2)      # loop excludes interior ring
+
+
+def test_function_errors_pass_through(session):
+    """A ValueError raised INSIDE a registered function must surface
+    as-is, not be relabelled 'unknown function' (review finding)."""
+    session.create_table("w", {"s": ["NOT A WKT"]})
+    with pytest.raises(ValueError, match="WKT parse error"):
+        session.sql("SELECT st_geomfromwkt(s) AS g FROM w")
+
+
+def test_self_join_requires_aliases(session):
+    session.create_table("sj", {"k": np.array([1, 2], np.int64)})
+    with pytest.raises(SQLError, match="distinct aliases"):
+        session.sql("SELECT k FROM sj JOIN sj ON sj.k = sj.k")
+    out = session.sql("SELECT a.k AS ka, b.k AS kb FROM sj a JOIN sj b "
+                      "ON a.k = b.k ORDER BY ka")
+    assert out.columns["ka"].tolist() == [1, 2]
+    assert out.columns["kb"].tolist() == [1, 2]

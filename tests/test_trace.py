@@ -1,0 +1,93 @@
+"""Tracing/observability tests.
+
+Reference counterparts: GDALCalc.scala:39-55 (last_command/last_error
+tile metadata), test/SparkSuite.scala:30-36 (benchmark helper), Spark UI
+timing (here: the span tracer wired into MosaicContext.call).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.raster.tile import GeoTransform, RasterTile
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.utils.trace import record_command, record_error, tracer
+
+
+@pytest.fixture(scope="module")
+def mc():
+    return MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)")
+
+
+@pytest.fixture
+def clean_tracer():
+    tracer.reset()
+    tracer.enable()
+    yield tracer
+    tracer.disable()
+    tracer.reset()
+
+
+def _tile():
+    gt = GeoTransform(0.0, 0.1, 0.0, 10.0, 0.0, -0.1)
+    return RasterTile(np.arange(100.0).reshape(10, 10)[None], gt)
+
+
+def test_span_timing_via_call(mc, clean_tracer):
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    g = read_wkt(["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"])
+    mc.call("st_area", g)
+    mc.call("st_area", g)
+    rep = clean_tracer.report()
+    s = rep["spans"]["call/st_area"]
+    assert s["calls"] == 2 and s["total_s"] >= 0.0
+    assert "call/st_area" in clean_tracer.format_report()
+
+
+def test_disabled_tracer_records_nothing(mc):
+    tracer.reset()
+    tracer.disable()
+    from mosaic_tpu.core.geometry.wkt import read_wkt
+    mc.call("st_area", read_wkt(["POINT (0 0)"]))
+    assert tracer.report()["spans"] == {}
+
+
+def test_nested_spans_qualify(clean_tracer):
+    with clean_tracer.span("outer"):
+        with clean_tracer.span("inner"):
+            pass
+    spans = clean_tracer.report()["spans"]
+    assert "outer" in spans and "outer/inner" in spans
+
+
+def test_counters(clean_tracer):
+    clean_tracer.count("chips", 5)
+    clean_tracer.count("chips", 2)
+    assert clean_tracer.report()["counters"]["chips"] == 7
+
+
+def test_map_algebra_records_last_command(mc):
+    t = _tile()
+    out = mc.rst_mapalgebra([t, t], lambda a, b: a + b)
+    assert "map_algebra" in out.meta["last_command"]
+
+
+def test_warp_records_last_command():
+    from mosaic_tpu.core.raster.rops import warp
+    gt = GeoTransform(-74.0, 0.01, 0.0, 41.0, 0.0, -0.01)
+    t = RasterTile(np.ones((1, 20, 20)), gt, srid=4326)
+    w = warp(t, 3857)
+    assert w.meta["last_command"].startswith("warp(")
+    assert w.meta["warped_from"] == "4326"
+
+
+def test_record_error_metadata():
+    t = _tile()
+    record_command(t, "rst_custom(x)")
+    try:
+        raise RuntimeError("boom with a very long message " + "x" * 400)
+    except RuntimeError as e:
+        record_error(t, e)
+    assert t.meta["last_command"] == "rst_custom(x)"
+    assert t.meta["last_error"].startswith("RuntimeError")
+    assert len(t.meta["last_error"]) <= 200
+    assert "full_error" in t.meta
