@@ -230,18 +230,34 @@ def tessellate_raster(tile: RasterTile, res: int,
     """Raster → one clipped tile per covering grid cell (reference:
     operator/retile/RasterTessellate.scala:30-57 — mosaicFill over the
     raster bbox, then getRasterForCell per chip)."""
-    xmin, ymin, xmax, ymax = tile.bbox()
-    ring = np.array([[xmin, ymin], [xmax, ymin], [xmax, ymax],
-                     [xmin, ymax], [xmin, ymin]])
-    from ..geometry.array import GeometryBuilder
-    b = GeometryBuilder()
-    b.add_polygon(ring)
-    bbox_geom = b.finish()
-    from ..tessellate import tessellate as tessellate_vec
-    chips = tessellate_vec(bbox_geom, res, grid, keep_core_geom=False)
+    # ONE vectorized ownership pass over every pixel center (same
+    # +1e-6-px nudge and point_to_cell convention as clip_to_cell, so
+    # the partition is identical) instead of a per-cell kernel call —
+    # batch-size-1 grid math dominated this op's runtime otherwise.
+    # The covering cell set IS unique(ownership): every pixel center
+    # lies in the raster bbox, so its cell intersects the bbox — no
+    # separate vector tessellation of the bbox is needed.
+    xs, ys = tile.pixel_centers()
+    nx = abs(tile.gt.px_w) * 1e-6
+    ny = abs(tile.gt.px_h) * 1e-6
+    pts = np.stack([xs.ravel() + nx, ys.ravel() + ny], axis=-1)
+    own = grid.point_to_cell(pts, res).reshape(tile.height, tile.width)
+    allowed = np.unique(own)
+    flat = own.ravel()
+    order = np.argsort(flat, kind="stable")
+    sorted_cells = flat[order]
+    rows = order // tile.width
+    cols = order % tile.width
+    lo = np.searchsorted(sorted_cells, allowed, side="left")
+    hi = np.searchsorted(sorted_cells, allowed, side="right")
     out = []
-    for cell in np.unique(chips.cell_id):
-        t = clip_to_cell(tile, int(cell), grid)
+    for cell, a, z in zip(allowed, lo, hi):
+        r0, r1 = int(rows[a:z].min()), int(rows[a:z].max()) + 1
+        c0, c1 = int(cols[a:z].min()), int(cols[a:z].max()) + 1
+        win = tile.window(c0, r0, c1 - c0, r1 - r0)
+        inside = own[r0:r1, c0:c1] == cell
+        t = dataclasses.replace(_mask_fill(win, inside),
+                                cell_id=int(cell))
         if t.width and t.height and not t.is_empty():
             out.append(t)
     return out

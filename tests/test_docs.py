@@ -1,0 +1,71 @@
+"""Documentation tests.
+
+Reference counterpart: docs/source/api/*.rst + usage pages.  Pins the
+generated API reference to the live registry (lock-step, like the R
+bindings) and sanity-checks the usage pages' code references.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+API = os.path.join(REPO, "docs", "api")
+USAGE = os.path.join(REPO, "docs", "usage")
+
+
+def test_api_reference_in_lockstep(tmp_path):
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "generate_docs.py"),
+                        str(tmp_path)], capture_output=True, text=True,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    for name in os.listdir(tmp_path):
+        fresh = open(os.path.join(tmp_path, name)).read()
+        committed = open(os.path.join(API, name)).read()
+        assert fresh == committed, \
+            f"docs/api/{name} stale — rerun tools/generate_docs.py"
+
+
+def test_every_registered_function_documented():
+    import mosaic_tpu.functions.context  # noqa: F401 (fills registry)
+    from mosaic_tpu.functions.registry import REGISTRY
+    docs = ""
+    for name in os.listdir(API):
+        docs += open(os.path.join(API, name)).read()
+    documented = set(re.findall(r"^## `([a-z_0-9]+)", docs, re.MULTILINE))
+    missing = set(REGISTRY) - documented
+    assert not missing, f"undocumented: {sorted(missing)}"
+
+
+def test_usage_pages_reference_real_symbols():
+    """Backticked mosaic_tpu symbols in usage pages must exist (guards
+    against docs drifting from the API)."""
+    import mosaic_tpu as mos
+    from mosaic_tpu.functions.context import MosaicContext
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        MosaicContext.build("CUSTOM(0,16,0,16,2,1,1)")
+    pages = [os.path.join(USAGE, f) for f in os.listdir(USAGE)]
+    pages.append(os.path.join(REPO, "docs", "index.md"))
+    for page in pages:
+        src = open(page).read()
+        for call in re.findall(r"mc\.([a-z_0-9]+)\(", src):
+            assert hasattr(MosaicContext, call), \
+                f"{os.path.basename(page)} references mc.{call} " \
+                f"which does not exist"
+        for call in re.findall(r"mos\.([a-z_0-9]+)\(", src):
+            assert hasattr(mos, call), \
+                f"{os.path.basename(page)} references mos.{call} " \
+                f"which does not exist"
+
+
+def test_usage_pages_exist_per_index():
+    index = open(os.path.join(REPO, "docs", "index.md")).read()
+    for rel in re.findall(r"\]\((usage/[a-z-]+\.md|api/index\.md)\)",
+                          index):
+        assert os.path.exists(os.path.join(REPO, "docs", rel)), rel
