@@ -165,8 +165,7 @@ def main():
 
     join = make_pip_join_fn(idx, grid)
     n_zones = len(polys)
-    recheck = host_recheck_fn(idx) if dense else (
-        lambda p, z, u: host_recheck(p, z, u, polys))
+    recheck = host_recheck_fn(idx, polys)
 
     def step(points):
         zone, uncertain = join(points)

@@ -22,6 +22,51 @@ _RES_SHIFT = 52
 _BASE_SHIFT = 45
 _MODE_SHIFT = 59
 
+# ---------------------------------------------- pentagon label interop
+# The internal wedge machinery (tables.py) deletes the pentagon subtree
+# geometrically opposite the home face interior — the I axis (digit 4)
+# for the canonical (2,0,0) anchors.  The published H3 spec instead
+# deletes the K axis (digit 1) and re-expresses the IK subtree via a
+# leading-digit-5 60° rotation.  Both label the SAME tiling; the exact
+# map between them (derived from the wedge layout, see
+# tests/test_h3_canonical.py) is a whole-string ±60° digit rotation
+# applied when the leading digit falls in the affected wedges:
+#   internal -> published: leading in {1, 5} -> rotate ccw
+#   published -> internal: leading in {5, 4} -> rotate cw
+_CCW8 = np.append(hm.ROT60_CCW_DIGIT, 7)   # 7 (pad) stays 7
+_CW8 = np.append(hm.ROT60_CW_DIGIT, 7)
+
+
+def _leading_digit(digits: np.ndarray) -> np.ndarray:
+    """First nonzero real digit per row (0 if none; 7-pads ignored)."""
+    lead = np.zeros(len(digits), np.int64)
+    for c in range(digits.shape[1]):
+        col = digits[:, c]
+        lead = np.where((lead == 0) & (col != 0) & (col < 7), col, lead)
+    return lead
+
+
+def _pent_to_external(base: np.ndarray, digits: np.ndarray) -> np.ndarray:
+    """Internal wedge labels -> published H3 digit labels."""
+    t = tables()
+    lead = _leading_digit(digits)
+    sel = t.is_pentagon[base] & ((lead == 1) | (lead == 5))
+    if np.any(sel):
+        digits = digits.copy()
+        digits[sel] = _CCW8[digits[sel]]
+    return digits
+
+
+def _pent_to_internal(base: np.ndarray, digits: np.ndarray) -> np.ndarray:
+    """Published H3 digit labels -> internal wedge labels."""
+    t = tables()
+    lead = _leading_digit(digits)
+    sel = t.is_pentagon[base] & ((lead == 5) | (lead == 4))
+    if np.any(sel):
+        digits = digits.copy()
+        digits[sel] = _CW8[digits[sel]]
+    return digits
+
 
 def _digit_shift(r: int) -> int:
     """Bit offset of the resolution-r digit (r in 1..15)."""
@@ -81,8 +126,8 @@ def is_valid_cell(cells: np.ndarray) -> np.ndarray:
         ok &= np.where(in_range, d < 7, d == 7)
         lead = np.where(in_range & (lead == 0) & (d != 0) & (d < 7), d,
                         lead)
-    # pentagon deleted subsequence
-    ok &= ~(t.is_pentagon[base] & (lead == t.pent_seam[base]))
+    # pentagon deleted subsequence: the K axis in published labels
+    ok &= ~(t.is_pentagon[base] & (lead == 1))
     return ok
 
 
@@ -113,16 +158,19 @@ def latlng_to_cell(latlng: np.ndarray, res: int) -> np.ndarray:
             f"uncalibrated face entries hit: f={f[bad]}, ijk={cur[bad]}")
     digits = t.rot_digit[rot[:, None], digits] if res else digits
     # pentagon seam re-expression (deleted subsequence)
-    lead = np.zeros(n, np.int64)
-    for c in range(digits.shape[1] if res else 0):
-        col = digits[:, c]
-        lead = np.where((lead == 0) & (col != 0), col, lead)
+    lead = _leading_digit(digits) if res else np.zeros(n, np.int64)
     seam_hit = t.is_pentagon[base] & (lead == t.pent_seam[base]) & \
         (lead != 0)
     if np.any(seam_hit):
         extra = t.fijk_pent_extra[f, cur[:, 0], cur[:, 1], cur[:, 2]]
         digits[seam_hit] = t.rot_digit[extra[seam_hit][:, None],
                                        digits[seam_hit]]
+        # extra is a whole-string rotation, so it also rotates the lead
+        lead[seam_hit] = t.rot_digit[extra[seam_hit], lead[seam_hit]]
+    # internal -> published pentagon labels (lead already in hand)
+    sel = t.is_pentagon[base] & ((lead == 1) | (lead == 5))
+    if np.any(sel):
+        digits[sel] = _CCW8[digits[sel]]
     return pack(base, digits[:, :res] if res else digits[:, :0], res)
 
 
@@ -144,6 +192,7 @@ def cell_to_latlng(cells: np.ndarray) -> np.ndarray:
     t = tables()
     cells = np.asarray(cells, np.int64).reshape(-1)
     base, digits, res = unpack(cells)
+    digits = _pent_to_internal(base, digits)
     out = np.zeros((len(cells), 2))
     for rv in np.unique(res):
         sel = res == rv
@@ -158,6 +207,7 @@ def _cell_lattice_context(cells: np.ndarray):
     """(tables, base, digits[,res], res, ijk) for a same-res batch."""
     t = tables()
     base, digits, res = unpack(cells)
+    digits = _pent_to_internal(base, digits)
     rv = int(res[0])
     assert np.all(res == rv), "mixed resolutions"
     digits = digits[:, :rv]
@@ -311,14 +361,12 @@ def cell_to_parent(cells: np.ndarray, parent_res: int) -> np.ndarray:
 
 def cell_to_children(cells: np.ndarray, child_res: int) -> list:
     """[N] -> list of arrays (ragged: pentagons have 6 children/level)."""
-    t = tables()
     out = []
     for c in np.atleast_1d(np.asarray(cells, np.int64)):
         res = int(get_resolution(np.array([c]))[0])
         assert child_res >= res
         cur = np.array([c], np.int64)
         for r in range(res + 1, child_res + 1):
-            base = (cur >> _BASE_SHIFT) & 0x7F
             pent = is_pentagon_cell(cur)
             cur = np.repeat(cur, 7)
             digit = np.tile(np.arange(7, dtype=np.int64), len(pent))
@@ -326,8 +374,8 @@ def cell_to_children(cells: np.ndarray, child_res: int) -> list:
             h |= np.int64(r) << _RES_SHIFT
             h &= ~(np.int64(7) << _digit_shift(r))
             h |= digit << _digit_shift(r)
-            drop = np.repeat(pent, 7) & \
-                (digit == np.repeat(t.pent_seam[base], 7))
+            # pentagon centers skip the K-axis child (published labels)
+            drop = np.repeat(pent, 7) & (digit == 1)
             cur = h[~drop]
         out.append(cur)
     return out

@@ -353,9 +353,14 @@ def cell_from_lattice_jax(face, ai, bi, res: int):
         lead = jnp.where((lead == 0) & (digits[rv] != 0), digits[rv],
                          lead)
     # pentagon seam re-expression
-    seam_hit = (c["is_pent"][base] == 1) & (lead == c["pent_seam"][base])\
-        & (lead != 0)
+    is_pent = c["is_pent"][base] == 1
+    seam_hit = is_pent & (lead == c["pent_seam"][base]) & (lead != 0)
     extra = jnp.where(seam_hit, c["fijk_extra"][entry], 0)
+    # internal -> published pentagon labels: after the extra rotation,
+    # subtrees with leading digit 1 or 5 rotate ccw once (index.py
+    # _pent_to_external carries the derivation)
+    lead_f = c["rot_digit"][extra * 7 + lead]
+    relabel = jnp.where(is_pent & ((lead_f == 1) | (lead_f == 5)), 1, 0)
     h = (jnp.int64(MODE_CELL) << _MODE_SHIFT) | \
         (jnp.int64(res) << _RES_SHIFT) | \
         (base.astype(jnp.int64) << _BASE_SHIFT)
@@ -365,6 +370,7 @@ def cell_from_lattice_jax(face, ai, bi, res: int):
     h = h | jnp.int64(fill)
     for rv in range(1, res + 1):
         d = c["rot_digit"][extra * 7 + digits[rv]]
+        d = c["rot_digit"][relabel * 7 + d]
         h = h | (d.astype(jnp.int64) << _digit_shift(rv))
     return h
 

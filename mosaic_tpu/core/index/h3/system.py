@@ -35,9 +35,6 @@ def _latlng_to_deg(latlng: np.ndarray) -> np.ndarray:
                      np.degrees(latlng[..., 0])], axis=-1)
 
 
-_INTEROP_WARNED = False
-
-
 class H3IndexSystem(IndexSystem):
     name = "H3"
     crs_id = 4326
@@ -46,26 +43,11 @@ class H3IndexSystem(IndexSystem):
     def __init__(self):
         self._inradius_deg: Dict[int, float] = {}
         self._circum_deg: Dict[int, float] = {}
-        # Raise the id-interop caveat to the API boundary (round-2
-        # advice): the grid is a faithful aperture-7 icosahedral DGGS
-        # with the H3 bit layout, but base-cell NUMBERING is derived
-        # numerically, not the canonical Uber assignment — ids do not
-        # interoperate with externally H3-indexed datasets.  Everything
-        # inside this framework (joins, tessellation, KNN) is
-        # self-consistent.  Silence with MOSAIC_TPU_SUPPRESS_H3_INTEROP=1.
-        global _INTEROP_WARNED
-        import os
-        if not _INTEROP_WARNED and os.environ.get(
-                "MOSAIC_TPU_SUPPRESS_H3_INTEROP", "").lower() not in (
-                "1", "true", "yes"):
-            import warnings
-            warnings.warn(
-                "mosaic_tpu H3 cell ids use a self-assigned base-cell "
-                "numbering; do not join them against ids produced by "
-                "the Uber H3 library (set "
-                "MOSAIC_TPU_SUPPRESS_H3_INTEROP=1 to silence)",
-                UserWarning, stacklevel=2)
-            _INTEROP_WARNED = True
+        # Cell ids are canonical (Uber H3-compatible): base cells follow
+        # the published spec assignment (h3/canonical.py) and pentagon
+        # subtrees carry the published K-axis labels, so ids join cleanly
+        # against externally H3-indexed datasets
+        # (tests/test_h3_canonical.py pins known vectors).
 
     def resolutions(self) -> range:
         return range(0, MAX_H3_RES + 1)

@@ -2,21 +2,22 @@
 
 The reference reaches H3 through JNI (com.uber:h3 3.7.0,
 /root/reference/pom.xml:92-96); the C core carries hand-maintained tables
-(base cell data, per-face lookup, neighbor rotations).  Here every table is
-*derived* from the icosahedron constants:
+(base cell data, per-face lookup, neighbor rotations).  Here the only
+hand-carried data is the published spec's base-cell assignment
+(canonical.py: number -> home face/ijk + pentagon flag); everything else
+is *derived* from the icosahedron constants:
 
   * the 122 resolution-0 cells are found by clustering the folded lattice
-    positions of every face's res-0 combos;
-  * pentagons are the 12 cells centered on icosahedron vertices;
-  * each cell's home is the lowest-index face containing its center;
+    positions of every face's res-0 combos, then matched 1:1 against the
+    canonical anchors (bijection asserted);
+  * pentagons are the 12 cells centered on icosahedron vertices — must
+    agree with the canonical pentagon flags;
   * the face->base-cell lookup and its digit-rotation calibration are fit
     empirically from probe descendants whose canonical digits are known by
     construction, with consistency asserted.
 
-Numbering is canonical to this library (descending latitude, then
-longitude) — the bit layout matches the published H3 spec but cell numbers
-are self-assigned, since no reference H3 build exists in this environment
-to copy them from.
+Cell ids therefore interoperate bit-for-bit with ids produced by the Uber
+H3 library (pinned by tests/test_h3_canonical.py's known vectors).
 """
 
 from __future__ import annotations
@@ -26,6 +27,7 @@ import itertools
 import numpy as np
 
 from . import hexmath as hm
+from .canonical import base_cell_table
 from .constants import NUM_BASE_CELLS, NUM_ICOSA_FACES
 from .fold import fold_geometry
 
@@ -65,40 +67,49 @@ class H3Tables:
         centers /= np.linalg.norm(centers, axis=-1, keepdims=True)
         assert len(centers) == NUM_BASE_CELLS, len(centers)
 
-        # canonical numbering: descending latitude, then longitude
-        geo_c = hm.xyz_to_geo(centers)
-        order = np.lexsort((np.round(geo_c[:, 1], 9),
-                            -np.round(geo_c[:, 0], 9)))
-        renum = np.empty(NUM_BASE_CELLS, np.int64)
-        renum[order] = np.arange(NUM_BASE_CELLS)
-        cluster = renum[cluster]
-        self.center_xyz = centers[order]
-        self.center_geo = geo_c[order]
+        # raw face -> cluster lookup over all combos (pre-renumber)
+        fijk_raw = np.full((n_f, 3, 3, 3), -1, np.int64)
+        fijk_raw[all_faces, all_ijk[:, 0], all_ijk[:, 1],
+                 all_ijk[:, 2]] = cluster
 
-        # pentagons: centered on icosahedron vertices
+        # canonical numbering: match each published home anchor
+        # (face, ijk) to its derived cluster; must be a bijection onto
+        # the 122 clusters or the spec table/geometry disagree
+        canon = base_cell_table()
+        renum = np.full(NUM_BASE_CELLS, -1, np.int64)
+        for b in range(NUM_BASE_CELLS):
+            f, i, j, k, _ = canon[b]
+            cl = fijk_raw[f, i, j, k]
+            assert cl >= 0, f"canonical anchor {b} off-lattice: {canon[b]}"
+            assert renum[cl] < 0, \
+                f"anchors {renum[cl]} and {b} collide on one cell"
+            renum[cl] = b
+        assert np.all(renum >= 0)
+        cluster = renum[cluster]
+        inv = np.empty(NUM_BASE_CELLS, np.int64)
+        inv[renum] = np.arange(NUM_BASE_CELLS)
+        self.center_xyz = centers[inv]
+        self.center_geo = hm.xyz_to_geo(self.center_xyz)
+
+        # pentagons: centered on icosahedron vertices; must agree with
+        # the published pentagon flags under the canonical numbering
         d = np.linalg.norm(self.center_xyz[:, None] -
                            geom.vertices[None], axis=-1)
         self.is_pentagon = np.any(d < 1e-9, axis=1)
         assert int(self.is_pentagon.sum()) == 12
+        assert np.array_equal(self.is_pentagon, canon[:, 4] == 1), \
+            np.nonzero(self.is_pentagon != (canon[:, 4] == 1))
 
         # face -> base cell lookup over all combos
         self.fijk_base = np.full((n_f, 3, 3, 3), -1, np.int64)
         self.fijk_base[all_faces, all_ijk[:, 0], all_ijk[:, 1],
                        all_ijk[:, 2]] = cluster
 
-        # home face/ijk: lowest face whose triangle contains the center
-        # (pentagons tie across 5 faces -> lowest index), using only
-        # normalized combos so home ijk is canonical
-        self.home_face = np.full(NUM_BASE_CELLS, -1, np.int64)
-        self.home_ijk = np.zeros((NUM_BASE_CELLS, 3), np.int64)
-        normed = np.all(all_ijk == hm.ijk_normalize(all_ijk), axis=-1)
-        inside = geom.beyond_edge(all_faces, hex2d, 0) < 0
-        for n in np.nonzero(normed & inside)[0]:
-            b = cluster[n]
-            if self.home_face[b] < 0 or all_faces[n] < self.home_face[b]:
-                self.home_face[b] = all_faces[n]
-                self.home_ijk[b] = all_ijk[n]
-        assert np.all(self.home_face >= 0)
+        # home face/ijk: the published anchors (digit orientation below
+        # res 0 is defined in the home-face frame, so the canonical home
+        # choice is what makes descendant ids interoperate)
+        self.home_face = canon[:, 0].copy()
+        self.home_ijk = canon[:, 1:4].copy()
 
         self._find_pentagon_seams(geom)
         self._calibrate_rotations(geom)
@@ -183,6 +194,13 @@ class H3Tables:
                 else:
                     assert seq == s, (b, seq, s)
             self.pent_seam[b] = seq[3]
+            # with the canonical anchors (all of the form (2,0,0): the
+            # vertex at the end of the home face's i-axis) the wedge
+            # opposite the interior is always the I axis; the published
+            # spec instead labels the deleted subsequence as the K axis
+            # via a leading-5 rotation — index._pent_to_external carries
+            # the exact relabeling, which relies on this being 4
+            assert seq[3] == 4, (b, seq)
             for pos, digit in enumerate(seq):
                 if pos == 0 or pos == 3:
                     continue

@@ -632,8 +632,8 @@ def make_dense_pip_join_fn(idx: DensePIPIndex, eps: float = EPS_EDGE_DEG,
     return fn
 
 
-def host_recheck_fn(idx: DensePIPIndex):
-    """Vectorized f64 host recheck bound to a dense index.
+def host_recheck_fn(idx, polys: Optional[GeometryArray] = None):
+    """Vectorized f64 host recheck bound to an index (either kind).
 
     Returns ``recheck(points64_abs, zone, uncertain) -> zone`` that
     reruns the flagged points through the SAME chip semantics in f64 —
@@ -641,7 +641,20 @@ def host_recheck_fn(idx: DensePIPIndex):
     the original unquantized chip edges.  Replaces the per-polygon
     Python loop (round-2 host_recheck) that VERDICT.md flagged as
     unscalable: this is a handful of numpy passes over the flagged
-    subset."""
+    subset.
+
+    For a sorted ``PIPIndex`` (no dense aux tables) the recheck
+    authority is the original polygons — pass ``polys``; the returned
+    closure wraps :func:`host_recheck`.  (Round-4 fix: this used to
+    raise AttributeError on the sorted index type.)"""
+    if not isinstance(idx, DensePIPIndex):
+        if polys is None:
+            raise ValueError(
+                "host_recheck_fn on a sorted PIPIndex needs the original "
+                "polygons: host_recheck_fn(idx, polys)")
+        return lambda pts, zone, uncertain: host_recheck(
+            np.asarray(pts), np.asarray(zone), np.asarray(uncertain),
+            polys)
     aux = idx.aux
     assert aux is not None, "recheck needs the build-time aux tables"
     entry = np.asarray(idx.entry)

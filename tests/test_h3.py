@@ -48,7 +48,8 @@ def test_roundtrip(rng_pts, res):
 def test_exhaustive_res2_universe():
     t = tables()
     base, digits, ijk = t._descend(2)
-    cells = ix.pack(base, digits, 2)
+    # _descend yields internal wedge labels; ids carry published labels
+    cells = ix.pack(base, ix._pent_to_external(base, digits), 2)
     assert len(cells) == 2 + 120 * 49
     assert len(np.unique(cells)) == len(cells)
     centers = t.develop(base, digits, ijk, 2)[1]
@@ -62,7 +63,7 @@ def test_exhaustive_res2_universe():
 def test_neighbor_symmetry():
     t = tables()
     base, digits, ijk = t._descend(1)
-    cells = ix.pack(base, digits, 1)
+    cells = ix.pack(base, ix._pent_to_external(base, digits), 1)
     nb, valid = ix.neighbors(cells)
     idx = {int(c): i for i, c in enumerate(cells)}
     for i in range(len(cells)):
@@ -93,7 +94,7 @@ def test_kring_kloop_counts(rng_pts):
 def test_boundary_partitions_sphere():
     t = tables()
     base, digits, ijk = t._descend(1)
-    cells = ix.pack(base, digits, 1)
+    cells = ix.pack(base, ix._pent_to_external(base, digits), 1)
     sysm = H3IndexSystem()
     areas = sysm.cell_area(cells)
     earth = 4 * np.pi * 6371.0088 ** 2
