@@ -48,6 +48,10 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.intersect_area_pairs.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_double, ctypes.c_void_p]
     return lib
 
 
@@ -74,6 +78,34 @@ def pip_first_match(points: np.ndarray, edges: np.ndarray,
     lib.pip_first_match(
         pts.ctypes.data, len(pts), ed.ctypes.data, gs.ctypes.data,
         len(gs) - 1, out.ctypes.data)
+    return out
+
+
+def intersect_area_pairs(edges_a: np.ndarray, off_a: np.ndarray,
+                         idx_a: np.ndarray,
+                         edges_b: np.ndarray, off_b: np.ndarray,
+                         idx_b: np.ndarray,
+                         eps: float = 1e-9) -> Optional[np.ndarray]:
+    """Exact f64 area(A∩B) per pair via boundary-fragment shoelace
+    sums (no ring stitching — see geokernels.cpp).  edges_* are [E, 4]
+    region-left directed edge POOLS over distinct geometries, off_*
+    their CSR offsets, idx_* [P] pool slots per pair.  Returns [P]
+    areas, or None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ea = np.ascontiguousarray(edges_a, np.float64)
+    eb = np.ascontiguousarray(edges_b, np.float64)
+    oa = np.ascontiguousarray(off_a, np.int64)
+    ob = np.ascontiguousarray(off_b, np.int64)
+    xa = np.ascontiguousarray(idx_a, np.int64)
+    xb = np.ascontiguousarray(idx_b, np.int64)
+    assert len(xa) == len(xb)
+    out = np.empty(len(xa), np.float64)
+    lib.intersect_area_pairs(ea.ctypes.data, oa.ctypes.data,
+                             xa.ctypes.data, eb.ctypes.data,
+                             ob.ctypes.data, xb.ctypes.data, len(xa),
+                             float(eps), out.ctypes.data)
     return out
 
 

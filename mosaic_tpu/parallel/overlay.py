@@ -79,18 +79,19 @@ def pack_chip_rows(polys: GeometryArray, res: int, grid: IndexSystem,
             chips.geom_id.astype(np.int32), edges, valid, origin, chips)
 
 
-def _pad_rows(cell, geom, edges, valid, rows_per_dev: int, n_dev: int):
-    """Round-robin row-block placement padded to [n_dev*rows_per_dev]."""
+def _pad_rows(cell, ids, edges, valid, rows_per_dev: int, n_dev: int):
+    """Round-robin row-block placement padded to [n_dev*rows_per_dev].
+    ``ids`` keeps its dtype (int32 geom ids or int64 row ids)."""
     n = len(cell)
     total = rows_per_dev * n_dev
     assert n <= total, (n, total)
     pad = total - n
     cell = np.concatenate([cell, np.full(pad, -1, np.int64)])
-    geom = np.concatenate([geom, np.full(pad, -1, np.int32)])
+    ids = np.concatenate([ids, np.full(pad, -1, ids.dtype)])
     edges = np.concatenate(
         [edges, np.full((pad, *edges.shape[1:]), 1e9, np.float32)])
     valid = np.concatenate([valid, np.zeros(pad, bool)])
-    return cell, geom, edges, valid
+    return cell, ids, edges, valid
 
 
 # ----------------------------------------------------------- device logic
@@ -243,42 +244,11 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
     D = mesh.shape[axis]
     assert bucket_cap > 0, "sharded overlay needs a bucket capacity"
 
-    def exchange(cell, geom, edges, valid, cap_e):
-        # route rows to hash(cell) % D with fixed-capacity buckets
-        dest = jnp.where(valid, _hash_dest(cell, D), D)  # invalid -> D
-        order = jnp.argsort(dest)
-        dest_s = dest[order]
-        pos = jnp.arange(dest.shape[0], dtype=jnp.int32) - \
-            jnp.searchsorted(dest_s, dest_s).astype(jnp.int32)
-        overflow = jnp.sum((pos >= bucket_cap) & (dest_s < D))
-        okrow = (dest_s < D) & (pos < bucket_cap)
-        # bad rows route to device index D: out of bounds, so the
-        # mode="drop" scatters discard them instead of clobbering the
-        # last in-bounds slot
-        d_i = jnp.where(okrow, dest_s, D)
-        p_i = jnp.where(okrow, pos, 0)
-        sc = jnp.full((D, bucket_cap), jnp.int64(-1))
-        sg = jnp.full((D, bucket_cap), jnp.int32(-1))
-        se = jnp.full((D, bucket_cap, cap_e, 4), jnp.float32(1e9))
-        sv = jnp.zeros((D, bucket_cap), bool)
-        sc = sc.at[d_i, p_i].set(jnp.where(okrow, cell[order], -1),
-                                 mode="drop")
-        sg = sg.at[d_i, p_i].set(jnp.where(okrow, geom[order], -1),
-                                 mode="drop")
-        se = se.at[d_i, p_i].set(jnp.where(okrow[:, None, None],
-                                           edges[order], 1e9),
-                                 mode="drop")
-        sv = sv.at[d_i, p_i].set(okrow & valid[order], mode="drop")
-        rc = jax.lax.all_to_all(sc, axis, 0, 0)
-        rg = jax.lax.all_to_all(sg, axis, 0, 0)
-        re = jax.lax.all_to_all(se, axis, 0, 0)
-        rv = jax.lax.all_to_all(sv, axis, 0, 0)
-        flat = lambda x: x.reshape((D * bucket_cap,) + x.shape[2:])
-        return flat(rc), flat(rg), flat(re), flat(rv), overflow
-
     def local(ca, gea, ea, va, cb, geb, eb, vb):
-        ca, gea, ea, va, ofa = exchange(ca, gea, ea, va, edge_cap_a)
-        cb, geb, eb, vb, ofb = exchange(cb, geb, eb, vb, edge_cap_b)
+        ca, gea, ea, va, ofa = _exchange_rows(
+            ca, gea, ea, va, D, axis, bucket_cap, edge_cap_a)
+        cb, geb, eb, vb, ofb = _exchange_rows(
+            cb, geb, eb, vb, D, axis, bucket_cap, edge_cap_b)
         h, z, dn = _local_sorted_join(ca, gea, ea, va, cb, geb, eb, vb,
                                       ga, gb, dup_cap, eps)
         diag = jnp.stack([ofa.astype(jnp.int32), ofb.astype(jnp.int32),
@@ -292,6 +262,273 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
                   P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P(), P()))
     return jax.jit(fn)
+
+
+# ----------------------------------------------------- ragged pair output
+
+def _compact_keys(keys, cap: int):
+    """[M] int64 keys (-1 invalid) -> ([cap] desc-sorted keys, count,
+    overflow).  Fixed capacity + overflow count: the same
+    never-silently-drop discipline as the exchange buckets."""
+    import jax.numpy as jnp
+    valid = keys >= 0
+    total = jnp.sum(valid)
+    srt = jnp.sort(keys)[::-1]
+    return srt[:cap], jnp.minimum(total, cap), \
+        jnp.maximum(total - cap, 0)
+
+
+def _local_pair_join(cell_a, row_a, edges_a, valid_a,
+                     cell_b, row_b, edges_b, valid_b,
+                     row_mult: int, dup_cap: int, pair_cap: int,
+                     eps: float):
+    """Sorted-table probe join emitting (hit|hazard) ROW pairs as a
+    compacted key list instead of scattering into a dense matrix
+    (VERDICT round-3 missing #4: the replicated [GA, GB] psum cannot
+    scale to millions of footprints).  Key = row_a * row_mult + row_b
+    over GLOBAL chip row ids; the caller maps rows to geometries or
+    chip edges.  Returns (keys [pair_cap], count, overflow,
+    dup_needed)."""
+    import jax
+    import jax.numpy as jnp
+
+    big = jnp.int64(0x7FFFFFFFFFFFFFFF)
+    key_a = jnp.where(valid_a, cell_a, big)
+    order = jnp.argsort(key_a)
+    key_a = key_a[order]
+    row_a = row_a[order]
+    edges_a = edges_a[order]
+
+    probe = jnp.where(valid_b, cell_b, -big)
+    start = jnp.searchsorted(key_a, probe)
+    upper = jnp.searchsorted(key_a, probe, side="right")
+    dup_needed = jnp.max(jnp.where(valid_b, upper - start, 0))
+
+    pair_fn = jax.vmap(
+        lambda ea, eb: _chip_pair_test(ea, eb, jnp.float32(eps)))
+    na = key_a.shape[0]
+    nb = cell_b.shape[0]
+
+    def body(j, buf):
+        s = jnp.clip(start + j, 0, max(na - 1, 0))
+        match = valid_b & (start + j < upper)
+        h, hz = pair_fn(edges_a[s], edges_b)
+        emit = match & (h | hz)
+        keys = jnp.where(
+            emit, row_a[s] * jnp.int64(row_mult) + row_b,
+            jnp.int64(-1))
+        return jax.lax.dynamic_update_slice(buf, keys, (j * nb,))
+
+    zero = (cell_b[:1] * 0).reshape(())     # device-varying seed
+    buf = jnp.full((dup_cap * nb,), jnp.int64(-1)) + zero
+    buf = jax.lax.fori_loop(0, dup_cap, body, buf)
+    keys, count, overflow = _compact_keys(buf, pair_cap)
+    return keys, count, overflow, dup_needed
+
+
+def make_overlay_pairs_fn(row_mult: int, edge_cap_a: int,
+                          edge_cap_b: int, mesh=None,
+                          axis: str = "data", bucket_cap: int = 0,
+                          dup_cap: int = 8, pair_cap: int = 0,
+                          eps: float = EPS_DEG):
+    """Build the pair-emitting overlay join kernel.
+
+    fn(cell_a, row_a, edges_a, valid_a, cell_b, row_b, edges_b,
+    valid_b) -> (keys, count, overflow_diag).  Without a mesh: one
+    device, keys [pair_cap].  With a mesh: rows all_to_all to
+    hash(cell) % D, each device emits its own compacted key block
+    (out_specs sharded — NO replicated matrix, NO psum), and the diag
+    carries (bucket_overflow_a, bucket_overflow_b, dup_needed,
+    pair_overflow) maxed across devices."""
+    import jax
+    import jax.numpy as jnp
+
+    assert pair_cap > 0
+    if mesh is None:
+        def fn(ca, ra, ea, va, cb, rb, eb, vb):
+            keys, count, ovf, dn = _local_pair_join(
+                ca, ra, ea, va, cb, rb, eb, vb, row_mult, dup_cap,
+                pair_cap, eps)
+            diag = jnp.stack([jnp.int32(0), jnp.int32(0),
+                              dn.astype(jnp.int32),
+                              ovf.astype(jnp.int32)])
+            return keys, count[None], diag
+        return jax.jit(fn)
+
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    D = mesh.shape[axis]
+    assert bucket_cap > 0
+
+    def local(ca, ra, ea, va, cb, rb, eb, vb):
+        ca, ra, ea, va, ofa = _exchange_rows(
+            ca, ra, ea, va, D, axis, bucket_cap, edge_cap_a)
+        cb, rb, eb, vb, ofb = _exchange_rows(
+            cb, rb, eb, vb, D, axis, bucket_cap, edge_cap_b)
+        keys, count, ovf, dn = _local_pair_join(
+            ca, ra, ea, va, cb, rb, eb, vb, row_mult, dup_cap,
+            pair_cap, eps)
+        diag = jnp.stack([ofa.astype(jnp.int32), ofb.astype(jnp.int32),
+                          dn.astype(jnp.int32), ovf.astype(jnp.int32)])
+        return keys, count[None], jax.lax.pmax(diag, axis)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis),) * 8,
+        out_specs=(P(axis), P(axis), P()))
+    return jax.jit(fn)
+
+
+def _exchange_rows(cell, row, edges, valid, D: int, axis: str,
+                   bucket_cap: int, cap_e: int):
+    """all_to_all row exchange keyed on hash(cell) % D, carrying one
+    id column (geom ids or global row ids — dtype preserved).  The
+    single exchange implementation behind both the dense-matrix and
+    the pair-emitting overlay paths."""
+    import jax
+    import jax.numpy as jnp
+    dest = jnp.where(valid, _hash_dest(cell, D), D)
+    order = jnp.argsort(dest)
+    dest_s = dest[order]
+    pos = jnp.arange(dest.shape[0], dtype=jnp.int32) - \
+        jnp.searchsorted(dest_s, dest_s).astype(jnp.int32)
+    overflow = jnp.sum((pos >= bucket_cap) & (dest_s < D))
+    okrow = (dest_s < D) & (pos < bucket_cap)
+    # bad rows route to device index D: out of bounds, so the
+    # mode="drop" scatters discard them instead of clobbering the
+    # last in-bounds slot
+    d_i = jnp.where(okrow, dest_s, D)
+    p_i = jnp.where(okrow, pos, 0)
+    sc = jnp.full((D, bucket_cap), jnp.int64(-1))
+    sr = jnp.full((D, bucket_cap), jnp.asarray(-1, row.dtype))
+    se = jnp.full((D, bucket_cap, cap_e, 4), jnp.float32(1e9))
+    sv = jnp.zeros((D, bucket_cap), bool)
+    sc = sc.at[d_i, p_i].set(jnp.where(okrow, cell[order], -1),
+                             mode="drop")
+    sr = sr.at[d_i, p_i].set(jnp.where(okrow, row[order], -1),
+                             mode="drop")
+    se = se.at[d_i, p_i].set(jnp.where(okrow[:, None, None],
+                                       edges[order], 1e9), mode="drop")
+    sv = sv.at[d_i, p_i].set(okrow & valid[order], mode="drop")
+    rc = jax.lax.all_to_all(sc, axis, 0, 0)
+    rr = jax.lax.all_to_all(sr, axis, 0, 0)
+    re = jax.lax.all_to_all(se, axis, 0, 0)
+    rv = jax.lax.all_to_all(sv, axis, 0, 0)
+    flat = lambda x: x.reshape((D * bucket_cap,) + x.shape[2:])
+    return flat(rc), flat(rr), flat(re), flat(rv), overflow
+
+
+def overlay_row_pairs(chips_a, chips_b, polys_a: GeometryArray,
+                      polys_b: GeometryArray, res: int,
+                      grid: IndexSystem, mesh=None,
+                      axis: str = "data",
+                      origin: Optional[np.ndarray] = None):
+    """Distributed chip-row pair discovery: all (rowA, rowB) chip pairs
+    that share a cell and (possibly) touch, as a ragged host list.
+
+    Returns (rows_a [K], rows_b [K]) global chip-row indices.  Memory
+    is bounded per device (capacity + overflow retry); the dense
+    [GA, GB] matrix never materializes."""
+    import jax.numpy as jnp
+
+    ra = pack_chip_rows(polys_a, res, grid, chips=chips_a,
+                        origin=origin)
+    origin = ra[4]
+    rb = pack_chip_rows(polys_b, res, grid, chips=chips_b,
+                        origin=origin)
+    ca, _, ea, va = ra[:4]
+    cb, _, eb, vb = rb[:4]
+    rowa = np.arange(len(ca), dtype=np.int64)
+    rowb = np.arange(len(cb), dtype=np.int64)
+    row_mult = int(len(cb)) + 1
+    ext = 1.0
+    for arr in (ea, eb):
+        fin = arr[np.abs(arr) < 1e8]
+        if len(fin):
+            ext = max(ext, float(np.abs(fin).max()))
+    eps = max(EPS_DEG, 64.0 * float(np.spacing(np.float32(ext))))
+
+    dup_cap = 8
+    if mesh is not None:
+        D = mesh.shape[axis]
+        rpa = -(-len(ca) // D)
+        rpb = -(-len(cb) // D)
+        ca, rowa, ea, va = _pad_rows(ca, rowa, ea, va, rpa, D)
+        cb, rowb, eb, vb = _pad_rows(cb, rowb, eb, vb, rpb, D)
+        bucket_cap = max(64, 2 * max(rpa, rpb))
+        pair_cap = max(1024, 4 * max(rpa, rpb))
+    else:
+        pair_cap = max(1024, 4 * len(ca))
+    args = tuple(jnp.asarray(v) for v in
+                 (ca, rowa, ea, va, cb, rowb, eb, vb))
+    while True:
+        if mesh is None:
+            fn = make_overlay_pairs_fn(
+                row_mult, ea.shape[1], eb.shape[1], dup_cap=dup_cap,
+                pair_cap=pair_cap, eps=eps)
+        else:
+            fn = make_overlay_pairs_fn(
+                row_mult, ea.shape[1], eb.shape[1], mesh=mesh,
+                axis=axis, bucket_cap=bucket_cap, dup_cap=dup_cap,
+                pair_cap=pair_cap, eps=eps)
+        keys, counts, diag = fn(*args)
+        diag = np.asarray(diag)
+        if mesh is not None and (diag[0] > 0 or diag[1] > 0):
+            bucket_cap *= 2
+            continue
+        if diag[2] > dup_cap:
+            dup_cap = int(2 ** np.ceil(np.log2(max(diag[2], 2))))
+            continue
+        if diag[3] > 0:
+            pair_cap *= 2
+            continue
+        break
+    keys = np.asarray(keys).reshape(-1)
+    counts = np.asarray(counts).reshape(-1)
+    if mesh is None:
+        valid = keys[:int(counts[0])]
+    else:
+        blocks = keys.reshape(len(counts), -1)
+        valid = np.concatenate([blocks[d, :int(counts[d])]
+                                for d in range(len(counts))])
+    valid = np.unique(valid)
+    return valid // row_mult, valid % row_mult
+
+
+def overlay_intersection_area(polys_a: GeometryArray,
+                              polys_b: GeometryArray, res: int,
+                              grid: IndexSystem, mesh=None,
+                              axis: str = "data"):
+    """Distributed exact ST_IntersectionAgg AREA: for every
+    intersecting polygon pair, the planar area of the intersection.
+
+    Mechanism (reference: tessellate + equi-join on cell id feeding
+    ST_IntersectionAgg, MosaicExplode.scala:70-79 +
+    ST_IntersectionAgg.scala:41-58): chips partition each polygon
+    within each cell, so area(A∩B) = Σ over shared cells of
+    area(chipA ∩ chipB).  The sharded join emits candidate chip-row
+    pairs (ragged, capacity-bounded); the exact per-pair areas run
+    through the native fragment-shoelace kernel
+    (clip.pairs_intersection_area), and a segment-sum folds them into
+    per-(geomA, geomB) totals.
+
+    Returns (ga [K], gb [K], area [K]) for pairs with area > 0."""
+    from ..core.geometry.clip import pairs_intersection_area
+    chips_a = tessellate(polys_a, res, grid, keep_core_geom=True)
+    chips_b = tessellate(polys_b, res, grid, keep_core_geom=True)
+    rows_a, rows_b = overlay_row_pairs(chips_a, chips_b, polys_a,
+                                       polys_b, res, grid, mesh, axis)
+    areas = pairs_intersection_area(chips_a.geoms, rows_a,
+                                    chips_b.geoms, rows_b)
+    ga = chips_a.geom_id[rows_a].astype(np.int64)
+    gb = chips_b.geom_id[rows_b].astype(np.int64)
+    mult = int(chips_b.geom_id.max(initial=0)) + 1
+    key = ga * mult + gb
+    uk, inv = np.unique(key, return_inverse=True)
+    tot = np.zeros(len(uk))
+    np.add.at(tot, inv, areas)
+    keep = tot > 0
+    return (uk[keep] // mult, uk[keep] % mult, tot[keep])
 
 
 # ------------------------------------------------------------ host oracle
