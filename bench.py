@@ -125,6 +125,14 @@ def main():
     ov_mism = int(np.sum(ov != overlay_host_truth(foot, polys)))
     log(f"overlay: {len(foot)} footprints x {len(polys)} zones in "
         f"{t_overlay:.2f}s; parity mismatches {ov_mism}")
+    # round-4: ragged pair emission + distributed intersection AREA
+    from mosaic_tpu.parallel.overlay import overlay_intersection_area
+    t0 = time.time()
+    oa_ga, oa_gb, oa_area = overlay_intersection_area(foot, polys, res,
+                                                      grid)
+    t_ovarea = time.time() - t0
+    log(f"overlay area: {len(oa_ga)} intersecting pairs, total "
+        f"{oa_area.sum():.3e} deg^2 in {t_ovarea:.2f}s")
 
     # BASELINE config 5: raster -> grid tessellation/aggregation
     from mosaic_tpu.core.raster.tile import GeoTransform, RasterTile
@@ -139,14 +147,47 @@ def main():
     log(f"raster_to_grid: 1000x800 px -> {len(r2g)} res-8 cells in "
         f"{t_r2g:.2f}s")
 
-    # BASELINE config 4: SpatialKNN (AIS pings x ports stand-in)
-    from mosaic_tpu.bench.workloads import nyc_points as _pts
+    # real-data lane (round-4): actual NYC taxi zones from the
+    # reference's Quickstart fixture, exact join parity
+    import json as _json
+    import os as _os
+    _zp = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                        "tests", "data", "nyc_taxi_zones.geojson")
+    from mosaic_tpu.core.geometry.geojson import read_geojson
+    feats = [_json.loads(l) for l in open(_zp) if l.strip()]
+    rzones = read_geojson([_json.dumps(f["geometry"]) for f in feats])
+    t0 = time.time()
+    ridx = build_pip_index(rzones, 9, grid)
+    rjoin = jax.jit(make_pip_join_fn(ridx, grid))
+    rng_r = np.random.default_rng(8)
+    rpts = np.stack([rng_r.uniform(-74.03, -73.93, 200_000),
+                     rng_r.uniform(40.69, 40.82, 200_000)], -1)
+    rzone, runc = rjoin(localize(ridx, rpts))
+    rzone = np.asarray(rzone).copy()
+    rzone = host_recheck_fn(ridx, rzones)(rpts, rzone,
+                                          np.asarray(runc))
+    t_real = time.time() - t0
+    rtruth = pip_host_truth(rpts[:30_000], rzones)
+    real_mism = int(np.sum(rzone[:30_000] != rtruth))
+    log(f"real zones: {len(rzones)} NYC taxi zones x 200k points in "
+        f"{t_real:.2f}s (incl index build); parity {real_mism}/30000")
+
+    # BASELINE config 4 AS SPECIFIED: AIS pings x world ports at
+    # GLOBAL extent (round-4: the multi-face windows make this run on
+    # device; previously the workload was shrunk to one NYC face)
     from mosaic_tpu.models import SpatialKNN, knn_host_truth
-    # full size on TPU; the CPU diagnostic fallback shrinks so the
-    # whole 5-config bench stays inside the driver's time budget
-    pings = _pts(1 << 20 if on_tpu else 1 << 17, seed=31)
-    ports = _pts(3000, seed=32)
-    knn = SpatialKNN(grid, k=5, index_resolution=8, max_iterations=64)
+    rngk = np.random.default_rng(31)
+    ports = np.stack([
+        rngk.uniform(-180, 180, 3000),
+        np.degrees(np.arcsin(rngk.uniform(-0.98, 0.98, 3000)))], -1)
+    n_pings = 1 << 20 if on_tpu else 1 << 17
+    ctr = ports[rngk.integers(0, len(ports), n_pings)]
+    pings = ctr + rngk.normal(0, 1.5, (n_pings, 2))
+    pings[:, 1] = np.clip(pings[:, 1], -88, 88)
+    # res 4 on TPU (finer rings, device does the work); res 3 on the
+    # CPU diagnostic fallback (fewer ring launches)
+    knn = SpatialKNN(grid, k=5, index_resolution=4 if on_tpu else 3,
+                     max_iterations=32)
     t0 = time.time()
     knn_out = knn.transform(pings, ports)
     t_knn_compile = time.time() - t0
@@ -237,9 +278,15 @@ def main():
         "tessellate_counties_s": round(t_counties, 2),
         "county_chips": len(cchips),
         "knn_rows_per_sec": round(knn_pps),
+        "knn_rows": len(pings),
+        "knn_global_extent": True,
         "knn_parity_mismatches": knn_mism,
         "overlay_s": round(t_overlay, 2),
         "overlay_parity_mismatches": ov_mism,
+        "overlay_area_s": round(t_ovarea, 2),
+        "overlay_area_pairs": len(oa_ga),
+        "real_zones_join_s": round(t_real, 2),
+        "real_zones_parity_mismatches": real_mism,
         "raster_to_grid_s": round(t_r2g, 2),
         "raster_to_grid_cells": len(r2g),
     }))

@@ -96,6 +96,12 @@ class GeometryArray:
         assert self.part_offsets[-1] == len(self.ring_offsets) - 1
         assert self.geom_offsets[-1] == len(self.part_offsets) - 1
         assert len(self.types) == len(self)
+        if self.part_types is not None:
+            # a mismatched array would silently misindex every
+            # part_types_effective consumer (wkb/wkt/geojson writers,
+            # padded edge builder) — fail at construction instead
+            assert len(self.part_types) == len(self.part_offsets) - 1, \
+                (len(self.part_types), len(self.part_offsets) - 1)
         assert np.all(np.diff(self.ring_offsets) >= 0)
         assert np.all(np.diff(self.part_offsets) >= 0)
         assert np.all(np.diff(self.geom_offsets) >= 0)

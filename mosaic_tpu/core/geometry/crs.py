@@ -237,11 +237,35 @@ _BOUNDS_4326: Dict[int, Tuple[float, float, float, float]] = {
     27700: (-8.82, 49.79, 1.92, 60.94),
 }
 
+_EPSG_TABLE = None
+
+
+def _epsg_table():
+    """Lazy-loaded per-EPSG bounds resource (epsg_bounds.npz): 3,258
+    EPSG codes with lat/lon + native-unit bounds, sourced from the
+    published spatialreference.org extents — the same resource list
+    the reference ships (core/crs/CRSBoundsProvider.scala:20,
+    src/main/resources/CRSBounds.csv).  Stored compressed; arrays are
+    (epsg sorted i32, geo [N, 4], proj [N, 4])."""
+    global _EPSG_TABLE
+    if _EPSG_TABLE is None:
+        import os
+        path = os.path.join(os.path.dirname(__file__),
+                            "epsg_bounds.npz")
+        z = np.load(path)
+        _EPSG_TABLE = (z["epsg"], z["geo"], z["proj"])
+    return _EPSG_TABLE
+
 
 def crs_bounds(epsg: int, reprojected: bool = True
                ) -> Tuple[float, float, float, float]:
     """(xmin, ymin, xmax, ymax) valid domain of an EPSG, either in its
-    own units (reprojected=True) or in lon/lat."""
+    own units (reprojected=True) or in lon/lat.
+
+    Lookup order: analytic bounds for the CRSs with full transform
+    support (exact), then the per-EPSG resource table (any of 3,258
+    codes — round-4: previously only the analytic handful resolved, so
+    st_hasvalidcoordinates rejected most real-world CRSs)."""
     if _is_utm(epsg):
         zone = epsg % 100
         ll = (zone * 6 - 186.0, -80.0 if epsg // 100 == 327 else 0.0,
@@ -253,7 +277,11 @@ def crs_bounds(epsg: int, reprojected: bool = True
     elif epsg in _BOUNDS_4326:
         ll = _BOUNDS_4326[epsg]
     else:
-        raise ValueError(f"no bounds registered for EPSG {epsg}")
+        codes, geo, proj = _epsg_table()
+        i = int(np.searchsorted(codes, epsg))
+        if i >= len(codes) or codes[i] != epsg:
+            raise ValueError(f"no bounds registered for EPSG {epsg}")
+        return tuple(proj[i] if reprojected else geo[i])
     if not reprojected or epsg == 4326:
         return ll
     corners = np.array([[ll[0], ll[1]], [ll[2], ll[1]],

@@ -150,6 +150,65 @@ class MosaicContext(RasterFunctions):
     def st_asgeojson(self, g: Geoms) -> List[str]:
         return write_geojson(g)
 
+    # --- ConvertTo format family (reference:
+    # expressions/format/ConvertTo.scala; registrations
+    # functions/MosaicContext.scala:124-129,228-276).  Inputs may be a
+    # GeometryArray or raw rows in any representation (WKT / WKB bytes
+    # / WKB-hex strings / GeoJSON strings); outputs are the named
+    # representation.
+    @staticmethod
+    def _read_any(rows) -> Geoms:
+        if isinstance(rows, GeometryArray):
+            return rows
+        rows = list(rows)
+        if not rows:
+            return GeometryArray.empty()
+        first = rows[0]
+        if isinstance(first, (bytes, bytearray)):
+            return read_wkb(rows)
+        if isinstance(first, str):
+            s = first.lstrip()
+            if s.startswith("{"):
+                from ..core.geometry.geojson import read_geojson
+                return read_geojson(rows)
+            import re
+            if re.fullmatch(r"[0-9A-Fa-f]+", s):
+                return read_wkb([bytes.fromhex(r) for r in rows])
+            return read_wkt(rows)
+        raise ValueError(
+            f"cannot infer geometry representation from {type(first)}")
+
+    def convert_to_wkt(self, rows) -> List[str]:
+        return write_wkt(self._read_any(rows))
+
+    def convert_to_wkb(self, rows) -> List[bytes]:
+        return write_wkb(self._read_any(rows))
+
+    def convert_to_hex(self, rows) -> List[str]:
+        """WKB as a lowercase hex string (reference hex payload)."""
+        return [b.hex() for b in write_wkb(self._read_any(rows))]
+
+    def convert_to_geojson(self, rows) -> List[str]:
+        return write_geojson(self._read_any(rows))
+
+    def convert_to_coords(self, rows) -> Geoms:
+        """The internal coordinate representation — here the columnar
+        GeometryArray itself (reference: its InternalGeometryType)."""
+        return self._read_any(rows)
+
+    def as_hex(self, rows) -> List[str]:
+        """reference registration: MosaicContext.scala:124"""
+        return self.convert_to_hex(rows)
+
+    def as_json(self, rows) -> List[str]:
+        """reference registration: MosaicContext.scala:129"""
+        return self.convert_to_geojson(rows)
+
+    # reference spells the tile aggregators with an underscore
+    # (MosaicContext.scala: st_asmvttile_agg / st_asgeojsontile_agg)
+    st_asmvttile_agg = st_asmvttileagg
+    st_asgeojsontile_agg = st_asgeojsontileagg
+
     def st_point(self, xs, ys) -> Geoms:
         """reference: expressions/constructors/ST_Point.scala"""
         xy = np.stack([np.asarray(xs, np.float64),
@@ -415,10 +474,18 @@ class MosaicContext(RasterFunctions):
         import dataclasses as _dc
         return self.st_transform(_dc.replace(g, srid=from_epsg), to_epsg)
 
-    def st_hasvalidcoordinates(self, g: Geoms, epsg: int,
+    def st_hasvalidcoordinates(self, g: Geoms, epsg,
                                which: str = "bounds") -> np.ndarray:
-        """reference: ST_HasValidCoordinates + CRSBoundsProvider"""
+        """reference: ST_HasValidCoordinates + CRSBoundsProvider —
+        ``epsg`` may be an int code or a "EPSG:nnnn" string (the
+        reference's crsCode form)."""
         from ..core.geometry.crs import has_valid_coordinates
+        if isinstance(epsg, str):
+            ds, _, code = epsg.partition(":")
+            if ds.upper() != "EPSG" or not code.isdigit():
+                raise ValueError(f"unsupported CRS code {epsg!r} "
+                                 "(EPSG:nnnn)")
+            epsg = int(code)
         ok = has_valid_coordinates(g.coords[:, :2], epsg, which)
         starts = g.vertex_starts()
         return np.asarray([bool(ok[starts[i]:starts[i + 1]].all())
@@ -611,6 +678,30 @@ class MosaicContext(RasterFunctions):
     grid_tessellateexplode = grid_tessellate
     mosaic_explode = grid_tessellate          # legacy alias (:549-557)
     mosaicfill = grid_tessellate
+    #: cell ids as LongType explicitly (reference grid_tessellateaslong
+    #: vs the string-id variant; ids here are int64 natively)
+    grid_tessellateaslong = grid_tessellate
+
+    # reference alias registrations (MosaicContext.scala:212-276,
+    # 549-557): spelled variants of existing functions
+    def flatten_polygons(self, g: Geoms) -> Geoms:
+        """reference: expressions/geometry/FlattenPolygons.scala —
+        explode multi-geometries into their parts (same as st_dump)."""
+        return self.st_dump(g)
+
+    def st_centroid2d(self, g: Geoms) -> Geoms:
+        return self.st_centroid(g)
+
+    def st_polygon(self, boundary: Geoms, holes=None) -> Geoms:
+        return self.st_makepolygon(boundary, holes)
+
+    def st_intersection_aggregate(self, left: ChipSet,
+                                  right: ChipSet) -> Geoms:
+        return self.st_intersection_agg(left, right)
+
+    def st_intersects_aggregate(self, left: ChipSet,
+                                right: ChipSet) -> bool:
+        return self.st_intersects_agg(left, right)
 
     def grid_boundary(self, cells) -> Geoms:
         verts, counts = self.index_system.cell_boundary(
@@ -866,14 +957,17 @@ def _auto_register() -> None:
     (functions/MosaicContext.scala:114-558)."""
     from .registry import register
     legacy = {"mosaic_explode", "mosaicfill", "point_index_geom",
-              "point_index_lonlat", "index_geometry"}
+              "point_index_lonlat", "index_geometry",
+              "flatten_polygons", "try_sql"}
+    fmt = {"as_hex", "as_json", "convert_to_wkt", "convert_to_wkb",
+           "convert_to_hex", "convert_to_geojson", "convert_to_coords"}
     for name in dir(MosaicContext):
         if name.startswith("_"):
             continue
         fn = getattr(MosaicContext, name)
         if not callable(fn):
             continue
-        if name.endswith("_agg"):
+        if name.endswith("_agg") or name.endswith("_aggregate"):
             group = "aggregator"
         elif name.startswith("st_"):
             group = "geometry"
@@ -881,6 +975,8 @@ def _auto_register() -> None:
             group = "grid"
         elif name.startswith("rst_"):
             group = "raster"
+        elif name in fmt:
+            group = "format"
         elif name in legacy:
             group = "legacy"
         else:

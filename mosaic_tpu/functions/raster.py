@@ -65,10 +65,21 @@ class RasterFunctions:
                     raise ValueError(
                         "NetCDF file has no 2D variables to expose "
                         "as a raster")
-                out.append(subs[sorted(subs)[0]])
+                out.append(self._first_sub(subs))
+            elif b[:4] == b"GRIB":
+                from ..io.grib import read_grib
+                out.append(self._first_sub(read_grib(b)))
             else:
                 out.append(read_gtiff(b))
         return out
+
+    @staticmethod
+    def _first_sub(subs):
+        """First subdataset of a container, siblings recorded in meta
+        for rst_subdatasets/rst_getsubdataset."""
+        t = subs[sorted(subs)[0]]
+        t.meta["subdatasets"] = ",".join(sorted(subs))
+        return t
 
     def rst_frombands(self, bands: Sequence[RasterTile]) -> RasterTile:
         """Stack single-band tiles into one raster (reference:
@@ -254,6 +265,10 @@ class RasterFunctions:
             if t.meta.get("driver") == "zarr":
                 from ..io.zarr import read_zarr
                 out.append(read_zarr(path)[name])
+            elif t.meta.get("driver") == "GRIB":
+                from ..io.grib import read_grib
+                with open(path, "rb") as fh:
+                    out.append(read_grib(fh.read())[name])
             else:
                 from ..io.netcdf import read_netcdf
                 with open(path, "rb") as fh:

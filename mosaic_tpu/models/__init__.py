@@ -2,8 +2,8 @@
 
 from .checkpoint import CheckpointManager
 from .core import BinaryTransformer, IterationState, IterativeTransformer
-from .knn import SpatialKNN, build_knn_index, knn_host_truth
+from .knn import SpatialKNN, build_knn_indexes, knn_host_truth
 
 __all__ = ["BinaryTransformer", "CheckpointManager", "IterationState",
-           "IterativeTransformer", "SpatialKNN", "build_knn_index",
+           "IterativeTransformer", "SpatialKNN", "build_knn_indexes",
            "knn_host_truth"]

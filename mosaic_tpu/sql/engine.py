@@ -168,9 +168,20 @@ class SQLSession:
         else:
             out = self._project(q.items, env, gen_items)
         if q.order_by:
+            grouped = q.group_by is not None or \
+                self._has_aggregate(q.items)
             keys = []
             for e, desc in reversed(q.order_by):
-                k = np.asarray(_numeric(self._eval(e, _Env({"_t": out}))))
+                try:
+                    v = self._eval(e, _Env({"_t": out}))
+                except SQLError:
+                    if grouped:
+                        raise  # pre-aggregation rows no longer exist
+                    # non-projected or qualified column: evaluate
+                    # against the pre-projection env (same row count
+                    # and order as the projected output)
+                    v = self._eval(e, env)
+                k = np.asarray(_numeric(v))
                 if not np.issubdtype(k.dtype, np.number):
                     # rank-encode so lexsort and DESC negation apply
                     _, k = np.unique(k, return_inverse=True)
@@ -302,7 +313,23 @@ class SQLSession:
             if isinstance(e, Call) and e.name in AGGREGATES:
                 cols[name] = self._agg_call(e, env, group_idx)
             else:
-                # must be (equal to) a grouping expression: take first
+                # must be a constant or match a grouping expression —
+                # silently taking any column's first row per group
+                # masks user errors a real engine rejects (round-4
+                # ADVICE).  Constants are legal alongside aggregates;
+                # Column matches ignore the table qualifier (t.x
+                # groups by x, like Spark's resolution).
+                def _matches(a, b):
+                    if a == b:
+                        return True
+                    return (isinstance(a, Column) and
+                            isinstance(b, Column) and a.name == b.name)
+                if not isinstance(e, Literal) and (
+                        q.group_by is None or
+                        not any(_matches(e, g) for g in q.group_by)):
+                    raise SQLError(
+                        f"non-aggregate SELECT item {name!r} must "
+                        "appear in GROUP BY")
                 vals = self._eval(e, env)
                 firsts = np.asarray([g[0] for g in group_idx], np.int64)
                 cols[name] = col_take(vals, firsts)
@@ -310,7 +337,18 @@ class SQLSession:
 
     def _agg_call(self, e: Call, env: _Env, group_idx):
         if e.name == "count":
-            return np.asarray([len(g) for g in group_idx], np.int64)
+            if len(e.args) == 0 or isinstance(e.args[0], Star):
+                return np.asarray([len(g) for g in group_idx],
+                                  np.int64)
+            # SQL semantics: count(col) skips NULL/NaN rows
+            vals = self._eval(e.args[0], env)
+            lst = vals if isinstance(vals, list) else \
+                np.asarray(vals).tolist()
+            ok = np.asarray(
+                [not (v is None or (isinstance(v, float) and
+                                    np.isnan(v))) for v in lst])
+            return np.asarray([int(ok[g].sum()) for g in group_idx],
+                              np.int64)
         if len(e.args) != 1:
             raise SQLError(f"{e.name} takes one argument")
         vals = np.asarray(_numeric(self._eval(e.args[0], env)))
@@ -335,7 +373,12 @@ class SQLSession:
                     cols.update(env.tables["#gen"].columns)
                 continue
             if isinstance(it.expr, Call) and id(it.expr) in gen_items:
-                cols.update(gen_items[id(it.expr)].columns)
+                # resolve from the env's '#gen' table — _take_env has
+                # already applied WHERE to it; the gen_items snapshot
+                # predates the filter and only identifies generator
+                # calls (round-4 ADVICE: a WHERE that dropped rows made
+                # the stale snapshot ragged vs the other columns)
+                cols.update(env.tables["#gen"].columns)
                 continue
             name = it.alias or self._default_name(it.expr, pos)
             cols[name] = self._eval(it.expr, env)

@@ -1,0 +1,57 @@
+"""GRIB codec over the reference's real CAMS fixture (binary copy of
+src/test/resources/binary/grib-cams — mixed GRIB1/GRIB2 messages)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.io.grib import read_grib
+
+FIX = os.path.join(os.path.dirname(__file__), "data", "cams_sample.grb")
+
+
+@pytest.fixture(scope="module")
+def tiles():
+    with open(FIX, "rb") as f:
+        return read_grib(f.read())
+
+
+def test_message_count_and_shapes(tiles):
+    assert len(tiles) == 14
+    for t in tiles.values():
+        assert t.data.shape == (1, 14, 14)
+        assert np.isfinite(t.data).all()
+
+
+def test_values_plausible(tiles):
+    # CAMS GO3 mass mixing ratios: ~1e-6 kg/kg
+    first = tiles[sorted(tiles)[0]].data
+    assert 1e-7 < np.nanmean(first) < 1e-5
+
+
+def test_georeferencing(tiles):
+    t = tiles[sorted(tiles)[0]]
+    # 14x14 cells of 0.75 deg, corner near (0, 9.75+half)
+    assert t.gt.px_w == pytest.approx(0.75)
+    assert t.gt.px_h == pytest.approx(-0.75)
+    # north-up: top-left latitude above bottom
+    assert t.gt.y0 > t.gt.y0 + 14 * t.gt.px_h
+
+
+def test_raster_api_dispatch():
+    from mosaic_tpu.functions.context import MosaicContext
+    mc = MosaicContext.build("H3")
+    t = mc.rst_fromfile([FIX])[0]
+    assert t.meta["driver"] == "GRIB"
+    subs = mc.rst_subdatasets([t])[0]
+    assert len(subs) == 14
+    other = sorted(subs)[1]
+    t2 = mc.rst_getsubdataset([t], other)[0]
+    assert t2.data.shape == (1, 14, 14)
+
+
+def test_editions_mixed(tiles):
+    # the fixture mixes GRIB2 (message 0) and GRIB1 messages
+    eds = {t.meta.get("edition") for t in tiles.values()}
+    assert eds == {"1", "2"}

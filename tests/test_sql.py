@@ -205,3 +205,51 @@ def test_self_join_requires_aliases(session):
                       "ON a.k = b.k ORDER BY ka")
     assert out.columns["ka"].tolist() == [1, 2]
     assert out.columns["kb"].tolist() == [1, 2]
+
+
+def test_explode_with_where_filter(session, mc):
+    """The docstring's flagship shape: WHERE runs AFTER the explode so
+    filters can reference generated columns — and the projection must
+    read generator columns from the FILTERED env (round-4 ADVICE high:
+    a WHERE that dropped rows raised 'ragged columns')."""
+    session.create_table("zones", {"geom": _zones(),
+                                   "zid": np.array([10, 20], np.int64)})
+    allrows = session.sql("SELECT zid, grid_tessellateexplode(geom, 3) "
+                          "FROM zones")
+    core = session.sql("SELECT zid, grid_tessellateexplode(geom, 3) "
+                       "FROM zones WHERE is_core")
+    ncore = int(np.asarray(allrows.columns["is_core"]).sum())
+    assert len(core) == ncore
+    assert np.asarray(core.columns["is_core"]).all()
+    # generated + carried columns stay row-aligned after the filter
+    assert len(core.columns["zid"]) == len(core.columns["index_id"])
+
+
+def test_group_by_rejects_ungrouped_column(session):
+    session.create_table("g2", {
+        "k": np.array([1, 1, 2], np.int64),
+        "v": np.array([1.0, 2.0, 3.0]),
+    })
+    import pytest as _pytest
+    from mosaic_tpu.sql.engine import SQLError
+    with _pytest.raises(SQLError, match="GROUP BY"):
+        session.sql("SELECT v, count(*) FROM g2 GROUP BY k")
+
+
+def test_count_column_skips_nulls(session):
+    session.create_table("g3", {
+        "k": np.array([1, 1, 2], np.int64),
+        "v": np.array([1.0, np.nan, 3.0]),
+    })
+    out = session.sql("SELECT k, count(v) AS n FROM g3 GROUP BY k "
+                      "ORDER BY k")
+    assert out.columns["n"].tolist() == [1, 1]
+
+
+def test_order_by_non_projected_column(session):
+    session.create_table("g4", {
+        "a": np.array([3, 1, 2], np.int64),
+        "b": np.array([30, 10, 20], np.int64),
+    })
+    out = session.sql("SELECT b FROM g4 ORDER BY a")
+    assert out.columns["b"].tolist() == [10, 20, 30]
