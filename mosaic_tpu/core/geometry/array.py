@@ -364,6 +364,39 @@ class GeometryBuilder:
         self._geoms.append(len(self._parts) - 1)
         self._types.append(int(gtype))
 
+    def add_empty_polygons(self, n: int) -> None:
+        """Append n empty POLYGON rows in one pass (each: one part, one
+        zero-vertex ring) — the bulk form of the core-chip placeholder
+        (keep_core_geom=False emits tens of thousands; per-row add()
+        was ~15% of county-scale tessellation)."""
+        if n <= 0:
+            return
+        self._rings.extend([self._nv] * n)
+        base_p = len(self._rings) - n
+        self._parts.extend(range(base_p, base_p + n))
+        base_g = len(self._parts) - n
+        self._geoms.extend(range(base_g, base_g + n))
+        self._types.extend([int(GeometryType.POLYGON)] * n)
+        self._part_types.extend([int(GeometryType.POLYGON)] * n)
+
+    def add_shell_polygons(self, shells) -> None:
+        """Append one single-ring POLYGON per entry of ``shells`` (each
+        a prepared closed [V, >=2] float64 ring) — the bulk form for
+        hole-free chip streams; skips add()'s per-ring normalization."""
+        for s in shells:
+            self._coords.append(s)
+            self._nv += len(s)
+            self._rings.append(self._nv)
+        n = len(shells)
+        if n == 0:
+            return
+        base_p = len(self._rings) - n
+        self._parts.extend(range(base_p, base_p + n))
+        base_g = len(self._parts) - n
+        self._geoms.extend(range(base_g, base_g + n))
+        self._types.extend([int(GeometryType.POLYGON)] * n)
+        self._part_types.extend([int(GeometryType.POLYGON)] * n)
+
     def add_point(self, xy) -> None:
         self.add(GeometryType.POINT, [[np.atleast_2d(xy)]])
 

@@ -54,6 +54,7 @@ class FoldGeometry:
 
     def __init__(self):
         self.vertices, self.face_verts = _icosa_vertices()
+        self._corner_cache = {}
         fc = face_center_xyz()
         # face adjacency: faces sharing 2 vertices
         self.edge_neighbor = np.full((NUM_ICOSA_FACES, 3), -1, np.int64)
@@ -94,12 +95,24 @@ class FoldGeometry:
                 got = self.fold_rot[f, e] @ nf
                 assert np.allclose(got, ng, atol=1e-12), (f, e)
 
+    def _corner_table(self, res: int) -> np.ndarray:
+        """[20, 3, 2] per-face corner hex2d positions at ``res``
+        (cached: beyond_edge/corner_hex2d used to re-project the 3
+        corners of every ROW's face per call — for 100k+ cells that
+        recomputation was ~15% of county-scale tessellation)."""
+        tbl = self._corner_cache.get(res)
+        if tbl is None:
+            faces = np.arange(NUM_ICOSA_FACES)
+            corner_geo = hm.xyz_to_geo(
+                self.vertices[self.face_verts[faces]])
+            _, tbl = hm.geo_to_hex2d(
+                corner_geo, res, np.repeat(faces[:, None], 3, axis=1))
+            self._corner_cache[res] = tbl
+        return tbl
+
     def corner_hex2d(self, face: np.ndarray, res: int) -> np.ndarray:
         """[N, 3, 2] face corner positions in the res's hex2d frame."""
-        corner_geo = hm.xyz_to_geo(self.vertices[self.face_verts[face]])
-        _, c_hex = hm.geo_to_hex2d(
-            corner_geo, res, np.repeat(face[:, None], 3, axis=1))
-        return c_hex
+        return self._corner_table(res)[face]
 
     def corner_edge(self, face: int, corner: int, ccw: bool) -> int:
         """Edge index crossed when orbiting ``corner`` ccw (or cw) out of
@@ -145,10 +158,8 @@ class FoldGeometry:
 
         Points beyond a corner report one of the two edges; iterate."""
         scale = hm.M_SQRT7 ** res
-        # face corner positions in this res's hex2d frame
-        corner_geo = hm.xyz_to_geo(self.vertices[self.face_verts[face]])
-        _, c_hex = hm.geo_to_hex2d(
-            corner_geo, res, np.repeat(face[:, None], 3, axis=1))
+        # face corner positions in this res's hex2d frame (cached table)
+        c_hex = self._corner_table(res)[face]
         out = np.full(len(face), -1, np.int64)
         best = np.zeros(len(face))
         for e in range(3):

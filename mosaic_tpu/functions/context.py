@@ -956,6 +956,8 @@ def _auto_register() -> None:
     against the reference's ~150-name surface
     (functions/MosaicContext.scala:114-558)."""
     from .registry import register
+    from .docstrings import apply as _apply_docstrings
+    _apply_docstrings(MosaicContext)
     legacy = {"mosaic_explode", "mosaicfill", "point_index_geom",
               "point_index_lonlat", "index_geometry",
               "flatten_polygons", "try_sql"}
