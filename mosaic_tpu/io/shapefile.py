@@ -340,9 +340,13 @@ def read_vector(path: str, driver: Optional[str] = None
     if not drv:
         ext = os.path.splitext(path)[1].lower()
         drv = {".shp": "esri shapefile", ".json": "geojson",
-               ".geojson": "geojson", ".wkt": "wkt"}.get(ext, "")
+               ".geojson": "geojson", ".wkt": "wkt",
+               ".gpkg": "gpkg"}.get(ext, "")
     if drv in ("esri shapefile", "shapefile", "shp"):
         return read_shapefile(path)
+    if drv in ("gpkg", "geopackage"):
+        from .geopackage import read_gpkg
+        return read_gpkg(path)
     if drv == "geojson":
         import json
         from ..core.geometry.geojson import read_geojson

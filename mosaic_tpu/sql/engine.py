@@ -50,6 +50,31 @@ def col_len(col) -> int:
     return len(col)
 
 
+def col_take_nullable(col, idx: np.ndarray):
+    """col_take where idx -1 means NULL (the LEFT JOIN emission).
+
+    FLOAT arrays host nulls as NaN; integer columns switch to python
+    lists with None (a float cast would corrupt int64 cell ids above
+    2^53); geometry columns cannot hold a null row — selecting one
+    through an outer join raises rather than emitting a broken
+    column."""
+    idx = np.asarray(idx, np.int64)
+    if -1 not in idx:
+        return col_take(col, idx)
+    if isinstance(col, GeometryArray):
+        raise SQLError(
+            "LEFT JOIN produced NULL geometry rows; geometry columns "
+            "have no null slot — select the right side's non-geometry "
+            "columns, or filter to matched rows first")
+    if isinstance(col, np.ndarray) and \
+            np.issubdtype(col.dtype, np.floating) and len(col):
+        out = col.astype(np.float64)[np.maximum(idx, 0)]
+        out[idx < 0] = np.nan
+        return out
+    return [None if (i < 0 or len(col) == 0) else col[int(i)]
+            for i in idx]
+
+
 def col_take(col, idx: np.ndarray):
     if isinstance(col, GeometryArray):
         return col.take(idx)
@@ -204,6 +229,19 @@ class SQLSession:
             raise SQLError(f"self-join needs distinct aliases "
                            f"(both sides are {lq!r})")
         li, ri = self._equi_join(left, lq, right, rq, q.join_on)
+        if q.join_kind == "left":
+            # unmatched left rows survive with nulls on the right
+            matched = np.zeros(len(left), bool)
+            matched[li] = True
+            lost = np.nonzero(~matched)[0]
+            li = np.concatenate([li, lost])
+            ri = np.concatenate([ri, np.full(len(lost), -1, np.int64)])
+            order = np.argsort(li, kind="stable")
+            li, ri = li[order], ri[order]
+            jl = left.take(li)
+            jr = Table({name: col_take_nullable(col, ri)
+                        for name, col in right.columns.items()})
+            return _Env({lq: jl, rq: jr})
         jl, jr = left.take(li), right.take(ri)
         return _Env({lq: jl, rq: jr})
 
@@ -351,12 +389,23 @@ class SQLSession:
                               np.int64)
         if len(e.args) != 1:
             raise SQLError(f"{e.name} takes one argument")
-        vals = np.asarray(_numeric(self._eval(e.args[0], env)))
+        raw = self._eval(e.args[0], env)
+        lst = raw if isinstance(raw, list) else \
+            np.asarray(raw).tolist()
+        # SQL NULL semantics: aggregates skip NULL (None / NaN) rows;
+        # an all-null group aggregates to NULL (NaN here)
+        vals = np.asarray(
+            [np.nan if v is None else float(v) for v in lst])
+        ok = ~np.isnan(vals)
         fn = {"sum": np.sum, "avg": np.mean, "mean": np.mean,
               "min": np.min, "max": np.max,
               "first": lambda v: v[0]}[e.name]
-        return np.asarray([fn(vals[g]) if len(g) else np.nan
-                           for g in group_idx])
+        out = []
+        for g in group_idx:
+            sel = np.asarray(g)[ok[g]] if len(g) else np.empty(0,
+                                                               int)
+            out.append(fn(vals[sel]) if len(sel) else np.nan)
+        return np.asarray(out)
 
     # -- projection
     def _project(self, items, env: _Env, gen_items) -> Table:

@@ -83,6 +83,7 @@ class Query:
     table: TableRef
     join: Optional[TableRef] = None
     join_on: Optional[object] = None
+    join_kind: str = "inner"
     where: Optional[object] = None
     group_by: Optional[List[object]] = None
     order_by: Optional[List[Tuple[object, bool]]] = None   # (expr, desc)
@@ -102,7 +103,7 @@ _TOKEN_RE = re.compile(r"""
 
 _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "and", "or", "not", "as", "join", "on", "asc", "desc",
-             "true", "false", "null", "is", "inner"}
+             "true", "false", "null", "is", "inner", "left", "outer"}
 
 
 def _tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -172,8 +173,16 @@ class _Parser:
         self.expect("kw", "from")
         table = self.table_ref()
         join = join_on = None
+        join_kind = "inner"
         if self.accept("kw", "inner"):
             self.expect("kw", "join")
+            join = self.table_ref()
+            self.expect("kw", "on")
+            join_on = self.expr()
+        elif self.accept("kw", "left"):
+            self.accept("kw", "outer")
+            self.expect("kw", "join")
+            join_kind = "left"
             join = self.table_ref()
             self.expect("kw", "on")
             join_on = self.expr()
@@ -200,8 +209,8 @@ class _Parser:
         if self.accept("kw", "limit"):
             limit = int(self.expect("num"))
         self.expect("eof")
-        return Query(items, table, join, join_on, where, group_by,
-                     order_by, limit)
+        return Query(items, table, join, join_on, join_kind, where,
+                     group_by, order_by, limit)
 
     def order_item(self) -> Tuple[object, bool]:
         e = self.expr()

@@ -253,3 +253,58 @@ def test_order_by_non_projected_column(session):
     })
     out = session.sql("SELECT b FROM g4 ORDER BY a")
     assert out.columns["b"].tolist() == [10, 20, 30]
+
+
+def test_left_join(session):
+    session.create_table("l", {"k": np.array([1, 2, 3], np.int64),
+                               "a": np.array([10., 20., 30.])})
+    session.create_table("r", {"k": np.array([1, 3], np.int64),
+                               "b": np.array([100., 300.])})
+    out = session.sql("SELECT l.k, a, b FROM l LEFT JOIN r "
+                      "ON l.k = r.k ORDER BY a")
+    assert out.columns["k"].tolist() == [1, 2, 3]
+    b = np.asarray(out.columns["b"], np.float64)
+    assert b[0] == 100.0 and np.isnan(b[1]) and b[2] == 300.0
+    # LEFT OUTER spelling too
+    out2 = session.sql("SELECT l.k FROM l LEFT OUTER JOIN r "
+                       "ON l.k = r.k")
+    assert len(out2) == 3
+
+
+def test_left_join_null_semantics(session):
+    import pytest as _pytest
+    from mosaic_tpu.sql.engine import SQLError
+    session.create_table("l2", {"k": np.array([1, 2, 3], np.int64),
+                                "a": np.array([10., 20., 30.])})
+    # empty right side: every row unmatched, still 3 output rows
+    session.create_table("r0", {"k": np.empty(0, np.int64),
+                                "b": np.empty(0)})
+    out = session.sql("SELECT l2.k, b FROM l2 LEFT JOIN r0 "
+                      "ON l2.k = r0.k")
+    assert len(out) == 3
+    assert all(v is None or (isinstance(v, float) and np.isnan(v))
+               for v in list(out.columns["b"]))
+    # int64 ids survive exactly through null-bearing columns
+    big = 613196571542765567
+    session.create_table("rc", {"k": np.array([1], np.int64),
+                                "cell": np.array([big], np.int64)})
+    out2 = session.sql("SELECT l2.k, cell FROM l2 LEFT JOIN rc "
+                       "ON l2.k = rc.k ORDER BY a")
+    assert list(out2.columns["cell"])[0] == big
+    assert list(out2.columns["cell"])[1] is None
+    # aggregates skip nulls; all-null group -> NaN
+    session.create_table("rv", {"k": np.array([1, 3], np.int64),
+                                "v": np.array([100., 300.])})
+    session.create_table("lj", session.sql(
+        "SELECT l2.k AS k, v FROM l2 LEFT JOIN rv ON l2.k = rv.k"
+    ).to_dict())
+    agg = session.sql("SELECT sum(v) AS s, count(v) AS n FROM lj")
+    assert agg.columns["s"].tolist() == [400.0]
+    assert agg.columns["n"].tolist() == [2]
+    # geometry columns refuse null rows loudly
+    import mosaic_tpu as mos
+    session.create_table("rg", {"k": np.array([1], np.int64),
+                                "g": mos.read_wkt(["POINT (0 0)"])})
+    with _pytest.raises(SQLError, match="null"):
+        session.sql("SELECT l2.k, g FROM l2 LEFT JOIN rg "
+                    "ON l2.k = rg.k")
