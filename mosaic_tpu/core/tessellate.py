@@ -620,6 +620,7 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
             gvpos = vpos[gi]
             keep_border = []
             run = []                 # pending single-shell chips (bulk)
+            bis = np.searchsorted(border_pair, p0 + border_rows)
 
             def _flush():
                 if run:
@@ -627,9 +628,7 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
                     run.clear()
 
             for i, row in enumerate(border_rows):
-                p = p0 + int(row)
-                bi = int(np.searchsorted(border_pair, p))
-                t0_ = tstart[bi]
+                t0_ = tstart[bis[i]]
                 polys = []           # (shell, [holes]) per surviving part
                 cur = None
                 jptr = 0
