@@ -77,6 +77,12 @@ class H3IndexSystem(IndexSystem):
         path for real data (point_to_cell)."""
         if res > 10:          # f32 device error vs tiny inradii
             return self.point_to_cell(xy, res)
+        if len(xy) < 32768:
+            # small lattices: padding to the fixed jit chunk would cost
+            # far more than the interpreted host pass (a 500-sample
+            # footprint bbox padded to 131k ran 80ms x 150 geometries —
+            # seen as a 3x overlay-bench regression)
+            return self.point_to_cell(xy, res)
         try:
             import jax
             import jax.numpy as jnp

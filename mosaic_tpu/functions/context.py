@@ -588,15 +588,22 @@ class MosaicContext(RasterFunctions):
         if len(left.cell_id) and not np.array_equal(left.cell_id,
                                                     right.cell_id):
             raise ValueError("chips must be matched on the same cell ids")
+        lc = left.is_core.astype(bool)
+        rc = right.is_core.astype(bool)
+        both = lc & rc
+        # ONE boundary call for every core∧core cell (was per-row);
+        # skip entirely when no row qualifies (an empty id batch has
+        # no resolution to develop)
+        cellg = self.grid_boundary(left.cell_id[both]) if both.any() \
+            else None
+        cell_at = {int(r): k for k, r in enumerate(np.nonzero(both)[0])}
         increments = []
         for i in range(len(left.cell_id)):
-            lc, rc = bool(left.is_core[i]), bool(right.is_core[i])
-            if lc and rc:
-                cellg = self.grid_boundary(left.cell_id[i:i + 1])
-                increments.append(geometry_rings(cellg, 0))
-            elif lc:
+            if both[i]:
+                increments.append(geometry_rings(cellg, cell_at[i]))
+            elif lc[i]:
                 increments.append(geometry_rings(right.geoms, i))
-            elif rc:
+            elif rc[i]:
                 increments.append(geometry_rings(left.geoms, i))
             else:
                 increments.append(rings_boolean(
@@ -609,11 +616,14 @@ class MosaicContext(RasterFunctions):
         cell) — reference: ST_UnionAgg."""
         from ..core.geometry.clip import (geometry_rings, rings_to_array,
                                           unary_union_rings)
+        core = chips.is_core.astype(bool)
+        cellg = self.grid_boundary(chips.cell_id[core]) if core.any() \
+            else None
+        cell_at = {int(r): k for k, r in enumerate(np.nonzero(core)[0])}
         regions = []
         for i in range(len(chips.cell_id)):
-            if bool(chips.is_core[i]):
-                cellg = self.grid_boundary(chips.cell_id[i:i + 1])
-                regions.append(geometry_rings(cellg, 0))
+            if core[i]:
+                regions.append(geometry_rings(cellg, cell_at[i]))
             else:
                 regions.append(geometry_rings(chips.geoms, i))
         return rings_to_array(unary_union_rings(regions))

@@ -24,6 +24,8 @@ from ..core.raster.tile import RasterTile
 
 __all__ = ["sharded_convolve"]
 
+_JIT_CACHE = {}
+
 
 def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
                      axis: str = "data") -> RasterTile:
@@ -77,10 +79,16 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
         return out[:, 0]
 
-    fn = jax.jit(shard_map(
-        local, mesh=mesh,
-        in_specs=P(None, axis, None),
-        out_specs=P(None, axis, None)))
+    # cache the compiled stencil: a fresh closure per call would
+    # retrace + recompile for every same-shaped tile in a pipeline
+    key = (id(mesh), axis, D, kh, kw, bands, H, W, k.tobytes())
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=P(None, axis, None),
+            out_specs=P(None, axis, None)))
+        _JIT_CACHE[key] = fn
     arr = jax.device_put(
         jnp.asarray(data),
         NamedSharding(mesh, P(None, axis, None)))

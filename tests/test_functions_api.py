@@ -151,3 +151,25 @@ def test_empty_point_wkt_roundtrip(ctx):
     blobs = ctx.st_aswkb(g)
     g2 = ctx.st_geomfromwkb(blobs)
     assert ctx.st_aswkt(g2) == ["POINT EMPTY"]
+
+
+def test_union_agg_no_core_chips(ctx):
+    """Aggregating a border-only ChipSet must not call grid_boundary
+    with an empty id batch (round-4 review: IndexError on H3)."""
+    import mosaic_tpu as mos
+    g = mos.read_wkt(
+        ["POLYGON ((-74.001 40.701, -73.9995 40.701, -73.9995 40.7025,"
+         " -74.001 40.7025, -74.001 40.701))"])
+    chips = ctx.grid_tessellate(g, 9, keep_core_geom=True)
+    border_only = chips
+    if chips.is_core.any():
+        import numpy as np
+        keep = np.nonzero(~chips.is_core)[0]
+        from mosaic_tpu.types import ChipSet
+        border_only = ChipSet(chips.geom_id[keep], chips.cell_id[keep],
+                              chips.is_core[keep],
+                              chips.geoms.take(keep))
+    u = ctx.st_union_agg(border_only)
+    assert len(u) >= 1
+    ia = ctx.st_intersection_agg(border_only, border_only)
+    assert len(ia) >= 1
