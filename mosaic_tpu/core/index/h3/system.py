@@ -349,6 +349,59 @@ class H3IndexSystem(IndexSystem):
             out.append(sub)
         return out
 
+    def cells_edge_sagitta_deg(self, cells: np.ndarray) -> float:
+        """EXACT max deviation (planar degrees) between each given
+        cell's true (gnomonic-straight) edges and the straight lon/lat
+        chords between its corners, over ALL the given cells.
+
+        Tessellation clips against the 6-corner lon/lat polygon of each
+        cell, while point->cell assignment follows the true gnomonic
+        boundary; a point within this band of a cell edge can be
+        (correctly) assigned to cell X yet fall outside X's polygonal
+        chip.  Join paths widen their uncertainty margin by the bound
+        computed over THEIR OWN cells (a sampled global "bound" missed
+        high-latitude cells 40x worse than the sample max — round-4
+        review).  Negligible at city resolutions (res 9: ~1e-7 deg),
+        ~0.3-13 deg at res 2 depending on latitude."""
+        cells = np.asarray(cells, np.int64)
+        if len(cells) == 0:
+            return 0.0
+        from . import hexmath as hm
+        from . import index as ixm
+        worst = 0.0
+        for rv in np.unique(ixm.get_resolution(cells)):
+            sub = cells[ixm.get_resolution(cells) == rv]
+            t, base, digits, _, ijk = ixm._cell_lattice_context(sub)
+            center_hex = hm.ijk_to_hex2d(ijk).astype(np.float64)
+            ang = np.radians(30.0 + 60.0 * np.arange(6))
+            off = np.stack([np.cos(ang), np.sin(ang)],
+                           -1) / np.sqrt(3.0)
+            for i in range(6):
+                j = (i + 1) % 6
+                _, ga = t.develop_hex2d(base, digits,
+                                        center_hex + off[i], int(rv))
+                _, gb = t.develop_hex2d(base, digits,
+                                        center_hex + off[j], int(rv))
+                _, gm = t.develop_hex2d(
+                    base, digits,
+                    center_hex + (off[i] + off[j]) / 2.0, int(rv))
+                # unwrap corner longitudes around the true midpoint
+                # (antimeridian-straddling cells would otherwise
+                # report ~180 deg deviations)
+                la = np.degrees(ga[:, ::-1])
+                lb = np.degrees(gb[:, ::-1])
+                true_mid = np.degrees(gm[:, ::-1])
+                for arr in (la, lb):
+                    dl = arr[:, 0] - true_mid[:, 0]
+                    arr[:, 0] -= 360.0 * np.round(dl / 360.0)
+                chord_mid = (la + lb) / 2.0
+                d = np.hypot(chord_mid[:, 0] - true_mid[:, 0],
+                             chord_mid[:, 1] - true_mid[:, 1])
+                worst = max(worst, float(np.max(d)))
+        # the mid-edge deviation of a parabolic-ish arc is the max to
+        # ~2nd order; 1.3x covers the higher-order remainder
+        return worst * 1.3
+
     # ------------------------------------------------------------- area
     def cell_area(self, cells: np.ndarray) -> np.ndarray:
         """Spherical-excess area in km² (reference: IndexSystem.area
