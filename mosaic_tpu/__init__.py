@@ -33,6 +33,7 @@ from .core.index.factory import get_index_system
 from .core.tessellate import tessellate, polyfill, point_chips
 from .types import ChipSet
 from .sql import SQLSession, prettified
+from . import io  # noqa: F401  (mos.io.read_vector / read_gpkg / ...)
 
 __version__ = "0.1.0"
 

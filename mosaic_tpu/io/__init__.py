@@ -1,0 +1,18 @@
+"""Datasource boundary: vector/raster format codecs + read strategies.
+
+Reference counterpart: the datasource/ package (OGRFileFormat driver
+dispatch, raster FileFormats, multi-read raster_to_grid).  Everything
+here is a pure-Python codec — no GDAL/OGR process dependency.
+"""
+
+from .shapefile import read_shapefile, read_vector, write_shapefile
+from .geopackage import gpkg_layers, read_gpkg, write_gpkg
+from .grib import grib_subdatasets, read_grib
+from .netcdf import netcdf_subdatasets, read_netcdf, write_netcdf
+
+__all__ = [
+    "read_vector", "read_shapefile", "write_shapefile",
+    "read_gpkg", "write_gpkg", "gpkg_layers",
+    "read_grib", "grib_subdatasets",
+    "read_netcdf", "write_netcdf", "netcdf_subdatasets",
+]
