@@ -16,9 +16,9 @@ dispatch by name through ``MosaicContext.call`` — the same string-dispatch
 boundary the reference's SQL registration uses — and evaluate columnar
 (row-wise semantics via equal-length vectorized kernels).
 
-Execution order: FROM/JOIN -> explode generator (if any select item is a
-generator call) -> WHERE -> GROUP BY/aggregate -> projection -> ORDER BY
--> LIMIT.  WHERE runs after the explode so filters can reference the
+Execution order: FROM/JOIN (inner or LEFT OUTER) -> explode generator
+(if any select item is a generator call) -> WHERE -> GROUP BY/aggregate
+(+ HAVING over the groups) -> projection -> ORDER BY -> LIMIT.  WHERE runs after the explode so filters can reference the
 generated ``is_core``/``index_id``/``wkb`` columns, matching how the
 reference's users filter tessellations.
 """
@@ -344,6 +344,12 @@ class SQLSession:
             group_idx = [np.flatnonzero(gid == g) for g in range(ngroups)]
         else:
             group_idx = [np.arange(n)]
+        if q.having is not None:
+            self._having_group_by = q.group_by
+            keep = _as_mask(self._eval_grouped(q.having, env,
+                                               group_idx),
+                            len(group_idx))
+            group_idx = [g for g, k in zip(group_idx, keep) if k]
         cols: Dict[str, object] = {}
         for pos, it in enumerate(q.items):
             name = it.alias or self._default_name(it.expr, pos)
@@ -372,6 +378,57 @@ class SQLSession:
                 firsts = np.asarray([g[0] for g in group_idx], np.int64)
                 cols[name] = col_take(vals, firsts)
         return Table(cols)
+
+    def _eval_grouped(self, e, env: _Env, group_idx):
+        """Evaluate a HAVING expression to one value per group:
+        aggregate calls run per group, other columns take each group's
+        first row (they are grouping expressions)."""
+        if isinstance(e, Literal):
+            return e.value
+        if isinstance(e, Call) and e.name in AGGREGATES:
+            return self._agg_call(e, env, group_idx)
+        if isinstance(e, Column):
+            # same discipline as grouped SELECT items: a bare column in
+            # HAVING must be a grouping expression, or the result would
+            # silently depend on each group's arbitrary first row
+            if self._having_group_by is None or not any(
+                    e == g or (isinstance(g, Column) and
+                               g.name == e.name)
+                    for g in self._having_group_by):
+                raise SQLError(
+                    f"HAVING column {e.name!r} must appear in GROUP BY")
+            vals = self._eval(e, env)
+            firsts = np.asarray([g[0] for g in group_idx], np.int64)
+            return _numeric(col_take(vals, firsts))
+        if isinstance(e, Unary):
+            if e.op == "not":
+                return ~_as_mask(self._eval_grouped(e.operand, env,
+                                                    group_idx),
+                                 len(group_idx))
+            v = self._eval_grouped(e.operand, env, group_idx)
+            if e.op == "-":
+                return -np.asarray(_numeric(v))
+            arr = np.asarray(_numeric(v), np.float64)
+            isna = np.asarray([x is None or (isinstance(x, float) and
+                                             np.isnan(x))
+                               for x in np.asarray(v).tolist()]) \
+                if not np.issubdtype(arr.dtype, np.number) else \
+                np.isnan(arr)
+            return isna if e.op == "isnull" else ~isna
+        if isinstance(e, Binary):
+            a = self._eval_grouped(e.left, env, group_idx)
+            b = self._eval_grouped(e.right, env, group_idx)
+            if e.op in ("and", "or"):
+                a = _as_mask(a, len(group_idx))
+                b = _as_mask(b, len(group_idx))
+                return (a & b) if e.op == "and" else (a | b)
+            import operator as op_
+            fn = {"+": op_.add, "-": op_.sub, "*": op_.mul,
+                  "/": op_.truediv, "%": op_.mod,
+                  "=": op_.eq, "!=": op_.ne, "<": op_.lt,
+                  "<=": op_.le, ">": op_.gt, ">=": op_.ge}[e.op]
+            return fn(_numeric(a), _numeric(b))
+        raise SQLError(f"unsupported HAVING expression {e!r}")
 
     def _agg_call(self, e: Call, env: _Env, group_idx):
         if e.name == "count":

@@ -86,6 +86,7 @@ class Query:
     join_kind: str = "inner"
     where: Optional[object] = None
     group_by: Optional[List[object]] = None
+    having: Optional[object] = None
     order_by: Optional[List[Tuple[object, bool]]] = None   # (expr, desc)
     limit: Optional[int] = None
 
@@ -103,7 +104,8 @@ _TOKEN_RE = re.compile(r"""
 
 _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "and", "or", "not", "as", "join", "on", "asc", "desc",
-             "true", "false", "null", "is", "inner", "left", "outer"}
+             "true", "false", "null", "is", "inner", "left", "outer",
+             "having"}
 
 
 def _tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -194,11 +196,17 @@ class _Parser:
         if self.accept("kw", "where"):
             where = self.expr()
         group_by = None
+        having = None
         if self.accept("kw", "group"):
             self.expect("kw", "by")
             group_by = [self.expr()]
             while self.accept("op", ","):
                 group_by.append(self.expr())
+        # standard SQL allows HAVING without GROUP BY (whole-table
+        # implicit group)
+        if self.accept("kw", "having"):
+            having = self.expr()
+
         order_by = None
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -210,7 +218,7 @@ class _Parser:
             limit = int(self.expect("num"))
         self.expect("eof")
         return Query(items, table, join, join_on, join_kind, where,
-                     group_by, order_by, limit)
+                     group_by, having, order_by, limit)
 
     def order_item(self) -> Tuple[object, bool]:
         e = self.expr()

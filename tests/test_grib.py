@@ -55,3 +55,21 @@ def test_editions_mixed(tiles):
     # the fixture mixes GRIB2 (message 0) and GRIB1 messages
     eds = {t.meta.get("edition") for t in tiles.values()}
     assert eds == {"1", "2"}
+
+
+def test_grib_raster_to_grid():
+    """Real CAMS data through the raster->H3 pipeline (BASELINE config
+    5 semantics over an actual reanalysis product)."""
+    import jax
+    from mosaic_tpu.core.index.factory import get_index_system
+    from mosaic_tpu.io.grib import read_grib
+    from mosaic_tpu.io.raster_grid import raster_to_grid
+    grid = get_index_system("H3")
+    with open(FIX, "rb") as f:
+        tiles = read_grib(f.read())
+    t = tiles[sorted(tiles)[0]]
+    cells = raster_to_grid([t], 2, grid, combiner="avg")
+    assert len(cells) > 10
+    vals = np.asarray(list(cells.values()))
+    ok = vals[np.isfinite(vals)]
+    assert len(ok) and 1e-8 < np.nanmean(ok) < 1e-5

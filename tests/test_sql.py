@@ -308,3 +308,36 @@ def test_left_join_null_semantics(session):
     with _pytest.raises(SQLError, match="null"):
         session.sql("SELECT l2.k, g FROM l2 LEFT JOIN rg "
                     "ON l2.k = rg.k")
+
+
+def test_group_by_having(session):
+    session.create_table("h1", {
+        "k": np.array([1, 1, 2, 2, 2, 3], np.int64),
+        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])})
+    out = session.sql("SELECT k, count(*) AS n, sum(v) AS s FROM h1 "
+                      "GROUP BY k HAVING count(*) >= 2 ORDER BY k")
+    assert out.columns["k"].tolist() == [1, 2]
+    assert out.columns["n"].tolist() == [2, 3]
+    out2 = session.sql("SELECT k FROM h1 GROUP BY k "
+                       "HAVING sum(v) > 3 AND k < 3")
+    assert sorted(np.asarray(out2.columns["k"]).tolist()) == [2]
+
+
+def test_having_edge_cases(session):
+    import pytest as _pytest
+    from mosaic_tpu.sql.engine import SQLError
+    session.create_table("h2", {
+        "k": np.array([1, 1, 2], np.int64),
+        "v": np.array([1.0, 2.0, 3.0])})
+    # HAVING without GROUP BY: whole-table implicit group
+    out = session.sql("SELECT count(*) AS n FROM h2 HAVING count(*) > 5")
+    assert len(out) == 0
+    out2 = session.sql("SELECT count(*) AS n FROM h2 HAVING count(*) > 2")
+    assert out2.columns["n"].tolist() == [3]
+    # unary minus inside HAVING
+    out3 = session.sql("SELECT k FROM h2 GROUP BY k "
+                       "HAVING -sum(v) < -2.5")
+    assert sorted(np.asarray(out3.columns["k"]).tolist()) == [1, 2]
+    # ungrouped bare column must raise, not take first rows
+    with _pytest.raises(SQLError, match="GROUP BY"):
+        session.sql("SELECT k FROM h2 GROUP BY k HAVING v > 1.5")
