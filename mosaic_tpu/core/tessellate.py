@@ -374,6 +374,114 @@ def classify_cells_multi(cell_verts: np.ndarray,
     return touching, core
 
 
+def _f64_jit_enabled() -> bool:
+    """Shared gate for the f64 XLA fast paths (classify parity, clip
+    buckets): jax present with x64 on, not explicitly disabled."""
+    import os
+    if os.environ.get("MOSAIC_TPU_DISABLE_CLIP_JIT"):
+        return False
+    try:
+        import jax
+        return bool(jax.config.jax_enable_x64)
+    except Exception:
+        return False
+
+
+_CLIP_JIT = {}
+
+
+def _clip_bucket_jitted(subj: np.ndarray, counts: np.ndarray,
+                        cv: np.ndarray, cc: np.ndarray):
+    """All half-plane passes of one clip bucket in ONE jitted kernel.
+
+    subj [M, W, 2] (W = subject width + kmax slack: Sutherland–Hodgman
+    adds at most one vertex per clip plane), counts [M], cv [M, K, 2],
+    cc [M].  Returns (subj', counts').  Compiles once per
+    (M, W, K) shape class; used when f64 is enabled (same guard as the
+    classify parity kernel), with _sh_halfplane as the interpreted
+    fallback."""
+    import jax
+    import jax.numpy as jnp
+    m, w = subj.shape[:2]
+    kmax = cv.shape[1]
+    key = (m, w, kmax)
+    fn = _CLIP_JIT.get(key)
+    if fn is None:
+        def kernel(subj, counts, cv, cc):
+            rows = jnp.arange(m)
+            vidx = jnp.arange(w)
+
+            def plane(kk, state):
+                subj, counts, overflow = state
+                active = kk < cc
+                p0 = jnp.take(cv, kk, axis=1)
+                nxt = jnp.where(kk + 1 >= cc, 0, kk + 1)
+                p1 = cv[rows, nxt]
+                ev = p1 - p0
+                valid = vidx[None, :] < counts[:, None]
+                nxt_v = jnp.take_along_axis(
+                    subj, jnp.where(vidx[None, :] + 1 >=
+                                    counts[:, None], 0,
+                                    vidx[None, :] + 1)[:, :, None],
+                    axis=1)
+                d_cur = ev[:, None, 0] * (subj[..., 1] -
+                                          p0[:, None, 1]) - \
+                    ev[:, None, 1] * (subj[..., 0] - p0[:, None, 0])
+                d_nxt = ev[:, None, 0] * (nxt_v[..., 1] -
+                                          p0[:, None, 1]) - \
+                    ev[:, None, 1] * (nxt_v[..., 0] - p0[:, None, 0])
+                in_cur = d_cur >= 0
+                in_nxt = d_nxt >= 0
+                denom = d_cur - d_nxt
+                t = jnp.where(denom != 0,
+                              d_cur / jnp.where(denom == 0, 1.0,
+                                                denom), 0.0)
+                inter = subj + t[..., None] * (nxt_v - subj)
+                emit_v = in_cur & valid
+                emit_i = (in_cur != in_nxt) & valid
+                n_emit = emit_v.astype(jnp.int32) + \
+                    emit_i.astype(jnp.int32)
+                pos = jnp.cumsum(n_emit, axis=1) - n_emit
+                new_count = n_emit.sum(axis=1)
+                new_subj = jnp.zeros_like(subj)
+                pv = jnp.where(emit_v, pos, w - 1)
+                new_subj = new_subj.at[rows[:, None], pv].set(
+                    jnp.where(emit_v[..., None], subj, 0.0),
+                    mode="drop")
+                # both scatters dump non-emitting lanes at slot w-1
+                # (guaranteed garbage by the width slack: a real
+                # vertex never lands there); the vertex scatter SETs
+                # zeros/values, the intersection scatter ADDs — their
+                # live targets are disjoint by construction
+                pi = jnp.where(emit_i, pos + emit_v, w - 1)
+                new_subj = new_subj.at[rows[:, None], pi].add(
+                    jnp.where(emit_i[..., None], inter, 0.0))
+                keep = ~active
+                subj = jnp.where(keep[:, None, None], subj, new_subj)
+                counts = jnp.where(active, new_count, counts)
+                # width overflow: a CONCAVE ring can emit up to one
+                # intersection per subject edge per plane, beyond the
+                # +1/plane slack sized for convex subjects.  Dropped
+                # scatters would silently corrupt the chip, so flag and
+                # let the caller redo the bucket on the growing numpy
+                # path (round-4 review caught the convex-only
+                # assumption).
+                overflow = overflow | jnp.any(
+                    active & (new_count > w - 1))
+                return subj, counts, overflow
+
+            subj, counts, overflow = jax.lax.fori_loop(
+                0, kmax, lambda kk, st: plane(kk, st),
+                (subj, counts, jnp.asarray(False)))
+            return subj, counts, overflow
+
+        fn = jax.jit(kernel)
+        _CLIP_JIT[key] = fn
+    o1, o2, ovf = fn(jnp.asarray(subj), jnp.asarray(counts),
+                     jnp.asarray(cv), jnp.asarray(cc))
+    return np.asarray(o1), np.asarray(o2), bool(ovf)
+
+
 def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
                       clip_verts: np.ndarray,
                       clip_counts: np.ndarray):
@@ -391,6 +499,7 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
     out = [None] * T
     if T == 0:
         return out
+    use_jit = _f64_jit_enabled()
     sizes = np.array([len(ring_pool[r]) for r in task_ring])
     kmax = clip_verts.shape[1]
     order = np.argsort(sizes, kind="stable")
@@ -407,7 +516,12 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
         # clipped against many cells; per-task filling dominated the
         # whole clip pass)
         uring, uinv = np.unique(task_ring[sel], return_inverse=True)
-        upad = np.zeros((len(uring), vcur, 2))
+        # jit path: fixed width with +1/plane slack (enough for convex
+        # subjects; concave overflow is DETECTED in-kernel and the
+        # chunk redone on the growing numpy path), task count padded
+        # to a fixed block so each bucket shape compiles once
+        wfix = vcur + kmax + 1 if use_jit else vcur
+        upad = np.zeros((len(uring), wfix, 2))
         ulen = np.zeros(len(uring), np.int64)
         for j, rid in enumerate(uring):
             r = ring_pool[rid]
@@ -417,12 +531,59 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
         counts = ulen[uinv]
         cv = clip_verts[sel]
         cc = clip_counts[sel]
-        for kk in range(kmax):
-            active = kk < cc
-            p0 = cv[:, kk]
-            nxt = np.where(kk + 1 >= cc, 0, kk + 1)
-            p1 = cv[np.arange(m), nxt]
-            subj, counts = _sh_halfplane(subj, counts, p0, p1, active)
+        if use_jit:
+            # FIXED task-block size: every bucket of a given
+            # (ring-size, kmax) class reuses one compiled shape, and a
+            # one-geometry warmup precompiles the same shape the full
+            # run uses
+            blk = 8192
+            so = np.empty_like(subj)
+            co = np.empty_like(counts)
+            for s2 in range(0, m, blk):
+                e2 = min(s2 + blk, m)
+                bs = np.zeros((blk, wfix, 2))
+                bc = np.zeros(blk, np.int64)
+                bv = np.zeros((blk, kmax, 2))
+                bk = np.zeros(blk, np.int64)
+                bs[:e2 - s2] = subj[s2:e2]
+                bc[:e2 - s2] = counts[s2:e2]
+                bv[:e2 - s2] = cv[s2:e2]
+                bk[:e2 - s2] = cc[s2:e2]
+                os_, oc_, ovf = _clip_bucket_jitted(bs, bc, bv, bk)
+                if ovf:
+                    # concave overflow: redo this chunk with the
+                    # dynamically-growing interpreted kernel
+                    cs = subj[s2:e2]
+                    ck = counts[s2:e2]
+                    for kk in range(kmax):
+                        act = kk < cc[s2:e2]
+                        p0 = cv[s2:e2, kk]
+                        nx = np.where(kk + 1 >= cc[s2:e2], 0, kk + 1)
+                        p1 = cv[s2:e2][np.arange(e2 - s2), nx]
+                        cs, ck = _sh_halfplane(cs, ck, p0, p1, act)
+                    pad_w = so.shape[1]
+                    if cs.shape[1] < pad_w:
+                        cs = np.pad(cs, ((0, 0),
+                                         (0, pad_w - cs.shape[1]),
+                                         (0, 0)))
+                    elif cs.shape[1] > pad_w:
+                        grow = cs.shape[1] - pad_w
+                        so = np.pad(so, ((0, 0), (0, grow), (0, 0)))
+                        pad_w = so.shape[1]
+                    so[s2:e2, :cs.shape[1]] = cs
+                    co[s2:e2] = ck
+                    continue
+                so[s2:e2] = os_[:e2 - s2]
+                co[s2:e2] = oc_[:e2 - s2]
+            subj, counts = so, co
+        else:
+            for kk in range(kmax):
+                active = kk < cc
+                p0 = cv[:, kk]
+                nxt = np.where(kk + 1 >= cc, 0, kk + 1)
+                p1 = cv[np.arange(m), nxt]
+                subj, counts = _sh_halfplane(subj, counts, p0, p1,
+                                             active)
         # close rings in one vectorized pass (callers previously
         # vstack'd a wrap vertex per chip — 68k calls at county scale)
         subj = np.concatenate(

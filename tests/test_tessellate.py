@@ -238,3 +238,69 @@ def test_pentagon_core_ring_closed():
         assert np.array_equal(shell[0], shell[-1]), "ring not closed"
         # 5 distinct vertices + closure
         assert len(np.unique(np.round(shell, 12), axis=0)) == 5
+
+
+def test_clip_jit_matches_numpy_path(monkeypatch):
+    """The jitted whole-bucket clip kernel must produce chips identical
+    to the interpreted half-plane path (same split points, same
+    emission order)."""
+    import jax
+    from mosaic_tpu.core.index.factory import get_index_system
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.core.geometry.array import GeometryBuilder
+    grid = get_index_system("H3")
+    rng = np.random.default_rng(12)
+    b = GeometryBuilder()
+    for _ in range(30):
+        cx, cy = rng.uniform(-74.2, -73.8), rng.uniform(40.6, 40.9)
+        ang = 2 * np.pi * (np.arange(9) +
+                           rng.uniform(-0.3, 0.3, 9)) / 9
+        rad = rng.uniform(0.003, 0.02, 9)
+        ring = np.stack([cx + rad * np.cos(ang),
+                         cy + rad * np.sin(ang)], -1)
+        b.add_polygon(np.vstack([ring, ring[:1]]))
+    polys = b.finish()
+    a = tessellate(polys, 8, grid, keep_core_geom=True)
+    monkeypatch.setenv("MOSAIC_TPU_DISABLE_CLIP_JIT", "1")
+    c = tessellate(polys, 8, grid, keep_core_geom=True)
+    assert np.array_equal(a.cell_id, c.cell_id)
+    assert np.array_equal(a.geom_id, c.geom_id)
+    # XLA may contract a*b+c into fma, so intersection coordinates can
+    # differ from numpy by ~1 ulp; chips stay self-consistent (the
+    # join's recheck uses the stored coordinates)
+    np.testing.assert_allclose(a.geoms.coords, c.geoms.coords,
+                               rtol=0, atol=1e-9)
+
+
+def test_clip_jit_concave_overflow_falls_back(monkeypatch):
+    """A concave zigzag ring emits more than one vertex per clip plane
+    — beyond the jit kernel's fixed width slack.  The kernel must
+    detect the overflow and the chunk redo on the interpreted path,
+    yielding output identical to the pure-numpy run (round-4 review:
+    the convex-only width assumption silently corrupted chips)."""
+    from mosaic_tpu.core.tessellate import convex_clip_tasks
+    # zigzag: 24 teeth straddling y=0.5 -> ~48 crossings on one plane
+    n = 24
+    xs = np.linspace(0.05, 0.95, 2 * n)
+    ys = np.tile([0.2, 0.8], n)
+    top = np.stack([xs, ys], -1)
+    ring = np.vstack([top, [[0.95, -0.5], [0.05, -0.5]]])
+    # square whose BOTTOM edge is the horizontal line y=0.5 — the
+    # first half-plane alone crosses all 24 teeth (~48 intersections),
+    # far beyond the +1/plane width slack
+    clip_verts = np.array([[[0.0, 0.5], [1.0, 0.5], [1.0, 1.0],
+                            [0.0, 1.0], [0.0, 0.0], [0.0, 0.0],
+                            [0.0, 0.0]]])
+    clip_counts = np.array([4])
+    task_ring = np.zeros(1, np.int64)
+    got = convex_clip_tasks([ring], task_ring,
+                            np.repeat(clip_verts, 1, axis=0),
+                            clip_counts)
+    monkeypatch.setenv("MOSAIC_TPU_DISABLE_CLIP_JIT", "1")
+    want = convex_clip_tasks([ring], task_ring,
+                             np.repeat(clip_verts, 1, axis=0),
+                             clip_counts)
+    assert (got[0] is None) == (want[0] is None)
+    if got[0] is not None:
+        np.testing.assert_array_equal(got[0], want[0])
+        assert len(got[0]) > len(ring) + 7 + 1  # genuinely overflowed
