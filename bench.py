@@ -99,10 +99,10 @@ def main():
     # BASELINE config 2: US-county-scale chip generation (host engine)
     from mosaic_tpu.bench.workloads import conus_counties
     counties = conus_counties()
-    # warm the clip/classify/sampling kernels on a slice big enough
-    # to hit every jitted shape (the candidate-sampling kernel only
-    # engages above 32k lattice points) so the timed run measures
-    # throughput, not XLA compiles
+    # warm the clip/classify/sampling kernels on a representative
+    # slice (covers the common jitted shapes incl. the >32k-point
+    # sampling kernel; a rare ring-size bucket may still compile in
+    # the timed run) so the timing is mostly throughput, not compiles
     tessellate(counties.take(list(range(256))), 5, grid,
                keep_core_geom=False)
     t0 = time.time()

@@ -250,13 +250,7 @@ def _parity_block(eg: np.ndarray, px: np.ndarray, py: np.ndarray,
     block size so each (Epad, Q) bucket compiles once); falls back to
     numpy otherwise — classification is an exact-f64 contract."""
     b, q = px.shape
-    use_jax = False
-    try:
-        import jax
-        use_jax = bool(jax.config.jax_enable_x64)
-    except Exception:
-        pass
-    if use_jax:
+    if _f64_jit_enabled():
         import jax.numpy as jnp
         key = (block, eg.shape[1], q)
         fn = _PARITY_JIT.get(key)
@@ -374,17 +368,35 @@ def classify_cells_multi(cell_verts: np.ndarray,
     return touching, core
 
 
-def _f64_jit_enabled() -> bool:
+def _f64_jit_enabled(disable_env: str = None) -> bool:
     """Shared gate for the f64 XLA fast paths (classify parity, clip
-    buckets): jax present with x64 on, not explicitly disabled."""
+    buckets): jax present with x64 on, and the path's opt-out env var
+    (if any) unset."""
     import os
-    if os.environ.get("MOSAIC_TPU_DISABLE_CLIP_JIT"):
+    if disable_env and os.environ.get(disable_env):
         return False
     try:
         import jax
         return bool(jax.config.jax_enable_x64)
     except Exception:
         return False
+
+
+def _sh_all_planes(subj, counts, cv, cc):
+    """Run every half-plane of each task's clip polygon through the
+    interpreted _sh_halfplane kernel — the single host driver behind
+    convex_clip_rings, convex_clip_tasks' numpy branch and the jit
+    overflow redo (three hand-synced copies is how subtle divergences
+    start)."""
+    m = len(subj)
+    kmax = cv.shape[1]
+    for kk in range(kmax):
+        active = kk < cc
+        p0 = cv[:, kk]
+        nxt = np.where(kk + 1 >= cc, 0, kk + 1)
+        p1 = cv[np.arange(m), nxt]
+        subj, counts = _sh_halfplane(subj, counts, p0, p1, active)
+    return subj, counts
 
 
 _CLIP_JIT = {}
@@ -395,11 +407,11 @@ def _clip_bucket_jitted(subj: np.ndarray, counts: np.ndarray,
     """All half-plane passes of one clip bucket in ONE jitted kernel.
 
     subj [M, W, 2] (W = subject width + kmax slack: Sutherland–Hodgman
-    adds at most one vertex per clip plane), counts [M], cv [M, K, 2],
-    cc [M].  Returns (subj', counts').  Compiles once per
-    (M, W, K) shape class; used when f64 is enabled (same guard as the
-    classify parity kernel), with _sh_halfplane as the interpreted
-    fallback."""
+    adds at most one vertex per clip plane for CONVEX subjects; concave
+    subjects can exceed it), counts [M], cv [M, K, 2], cc [M].
+    Returns (subj', counts', overflow [M] bool) — rows whose width
+    overflowed carry garbage and must be redone on the growing
+    interpreted path.  Compiles once per (M, W, K) shape class."""
     import jax
     import jax.numpy as jnp
     m, w = subj.shape[:2]
@@ -466,20 +478,19 @@ def _clip_bucket_jitted(subj: np.ndarray, counts: np.ndarray,
                 # let the caller redo the bucket on the growing numpy
                 # path (round-4 review caught the convex-only
                 # assumption).
-                overflow = overflow | jnp.any(
-                    active & (new_count > w - 1))
+                overflow = overflow | (active & (new_count > w - 1))
                 return subj, counts, overflow
 
             subj, counts, overflow = jax.lax.fori_loop(
                 0, kmax, lambda kk, st: plane(kk, st),
-                (subj, counts, jnp.asarray(False)))
+                (subj, counts, jnp.zeros(m, bool)))
             return subj, counts, overflow
 
         fn = jax.jit(kernel)
         _CLIP_JIT[key] = fn
     o1, o2, ovf = fn(jnp.asarray(subj), jnp.asarray(counts),
                      jnp.asarray(cv), jnp.asarray(cc))
-    return np.asarray(o1), np.asarray(o2), bool(ovf)
+    return np.asarray(o1), np.asarray(o2), np.asarray(ovf)
 
 
 def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
@@ -499,7 +510,7 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
     out = [None] * T
     if T == 0:
         return out
-    use_jit = _f64_jit_enabled()
+    use_jit = _f64_jit_enabled("MOSAIC_TPU_DISABLE_CLIP_JIT")
     sizes = np.array([len(ring_pool[r]) for r in task_ring])
     kmax = clip_verts.shape[1]
     order = np.argsort(sizes, kind="stable")
@@ -533,12 +544,14 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
         cc = clip_counts[sel]
         if use_jit:
             # FIXED task-block size: every bucket of a given
-            # (ring-size, kmax) class reuses one compiled shape, and a
-            # one-geometry warmup precompiles the same shape the full
-            # run uses
-            blk = 8192
-            so = np.empty_like(subj)
-            co = np.empty_like(counts)
+            # (ring-size, kmax) class reuses one compiled shape, and
+            # the bench warmup precompiles the common shapes.  Tiny
+            # buckets use a smaller pow2 block so a 5-task bucket of
+            # 4096-vertex rings does not allocate 8192-row arrays.
+            blk = min(8192, 1 << int(np.ceil(np.log2(max(m, 128)))))
+            so = np.zeros_like(subj)
+            co = np.zeros_like(counts)
+            redo_rows = []
             for s2 in range(0, m, blk):
                 e2 = min(s2 + blk, m)
                 bs = np.zeros((blk, wfix, 2))
@@ -550,40 +563,27 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
                 bv[:e2 - s2] = cv[s2:e2]
                 bk[:e2 - s2] = cc[s2:e2]
                 os_, oc_, ovf = _clip_bucket_jitted(bs, bc, bv, bk)
-                if ovf:
-                    # concave overflow: redo this chunk with the
-                    # dynamically-growing interpreted kernel
-                    cs = subj[s2:e2]
-                    ck = counts[s2:e2]
-                    for kk in range(kmax):
-                        act = kk < cc[s2:e2]
-                        p0 = cv[s2:e2, kk]
-                        nx = np.where(kk + 1 >= cc[s2:e2], 0, kk + 1)
-                        p1 = cv[s2:e2][np.arange(e2 - s2), nx]
-                        cs, ck = _sh_halfplane(cs, ck, p0, p1, act)
-                    pad_w = so.shape[1]
-                    if cs.shape[1] < pad_w:
-                        cs = np.pad(cs, ((0, 0),
-                                         (0, pad_w - cs.shape[1]),
-                                         (0, 0)))
-                    elif cs.shape[1] > pad_w:
-                        grow = cs.shape[1] - pad_w
-                        so = np.pad(so, ((0, 0), (0, grow), (0, 0)))
-                        pad_w = so.shape[1]
-                    so[s2:e2, :cs.shape[1]] = cs
-                    co[s2:e2] = ck
-                    continue
                 so[s2:e2] = os_[:e2 - s2]
                 co[s2:e2] = oc_[:e2 - s2]
+                bad = np.nonzero(ovf[:e2 - s2])[0]
+                if len(bad):
+                    redo_rows.append(s2 + bad)
             subj, counts = so, co
+            if redo_rows:
+                # concave overflow: redo ONLY the overflowed rows with
+                # the dynamically-growing interpreted kernel
+                rr = np.concatenate(redo_rows)
+                cs, ck = _sh_all_planes(upad[uinv[rr]].copy(),
+                                        ulen[uinv[rr]].copy(),
+                                        cv[rr], cc[rr])
+                if cs.shape[1] > subj.shape[1]:
+                    subj = np.pad(subj, ((0, 0),
+                                         (0, cs.shape[1] -
+                                          subj.shape[1]), (0, 0)))
+                subj[rr, :cs.shape[1]] = cs
+                counts[rr] = ck
         else:
-            for kk in range(kmax):
-                active = kk < cc
-                p0 = cv[:, kk]
-                nxt = np.where(kk + 1 >= cc, 0, kk + 1)
-                p1 = cv[np.arange(m), nxt]
-                subj, counts = _sh_halfplane(subj, counts, p0, p1,
-                                             active)
+            subj, counts = _sh_all_planes(subj, counts, cv, cc)
         # close rings in one vectorized pass (callers previously
         # vstack'd a wrap vertex per chip — 68k calls at county scale)
         subj = np.concatenate(
@@ -621,13 +621,8 @@ def convex_clip_rings(rings, clip_verts: np.ndarray,
         # current subject per cell: [M, Vcur, 2] + mask
         subj = np.broadcast_to(r[None], (m, len(r), 2)).copy()
         counts = np.full(m, len(r), dtype=np.int64)
-        for kk in range(kmax):
-            # half-plane: edge clip_verts[:,kk] -> clip_verts[:,(kk+1)%cnt]
-            active = kk < clip_counts
-            p0 = clip_verts[:, kk]
-            nxt = np.where(kk + 1 >= clip_counts, 0, kk + 1)
-            p1 = clip_verts[np.arange(m), nxt]
-            subj, counts = _sh_halfplane(subj, counts, p0, p1, active)
+        subj, counts = _sh_all_planes(subj, counts, clip_verts,
+                                      clip_counts)
         for i in range(m):
             c = int(counts[i])
             if c >= 3:

@@ -174,3 +174,25 @@ def test_device_kernel_matches_host_canonical():
             # disagreements must be boundary cells (neighbor ids)
             ring = ix.k_ring(host[bad], 1)
             assert np.all(np.any(ring == dev[bad, None], axis=1))
+
+
+def test_cell_universe_counts_and_mean_areas():
+    """Published H3 universe constants: cell counts per res are exact
+    (122 / 842 / 5882); mean hexagon areas match the published tables
+    within the projected-corner boundary convention's deviation (this
+    framework's boundaries are chosen to agree with point_to_cell, not
+    the true spherical cell — ~0.5% at res 1, ~0.07% at res 2,
+    vanishing at city resolutions)."""
+    sysm = H3IndexSystem()
+    res0 = ix.pack(np.arange(122, dtype=np.int64),
+                   np.zeros((122, 0), np.int64), 0)
+    k1 = np.concatenate(ix.cell_to_children(res0, 1))
+    assert len(k1) == 842
+    a1 = sysm.cell_area(k1)
+    hex1 = ~ix.is_pentagon_cell(k1)
+    assert a1[hex1].mean() == pytest.approx(607220.9782, rel=1e-2)
+    k2 = np.concatenate(ix.cell_to_children(res0, 2))
+    assert len(k2) == 5882
+    a2 = sysm.cell_area(k2)
+    hex2 = ~ix.is_pentagon_cell(k2)
+    assert a2[hex2].mean() == pytest.approx(86745.85403, rel=2e-3)

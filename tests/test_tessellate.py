@@ -304,3 +304,40 @@ def test_clip_jit_concave_overflow_falls_back(monkeypatch):
     if got[0] is not None:
         np.testing.assert_array_equal(got[0], want[0])
         assert len(got[0]) > len(ring) + 7 + 1  # genuinely overflowed
+
+
+def test_clip_jit_mixed_overflow_same_bucket(monkeypatch):
+    """Concave (overflowing) and convex rings of the SAME size bucket
+    in one jit chunk: only the overflowed ROWS redo on the interpreted
+    path (bit-equal there), convex rows keep the jit result (1-ulp
+    tolerance) — round-4 review: a chunk-wide redo threw away good
+    work, and a grown output buffer crashed later chunks."""
+    from mosaic_tpu.core.tessellate import convex_clip_tasks
+    n = 24
+    xs = np.linspace(0.05, 0.95, 2 * n)
+    ys = np.tile([0.2, 0.8], n)
+    zig = np.vstack([np.stack([xs, ys], -1),
+                     [[0.95, -0.5], [0.05, -0.5]]])
+    th = np.linspace(0, 2 * np.pi, 51)[:-1]
+    circ = np.stack([0.5 + 0.4 * np.cos(th),
+                     0.5 + 0.4 * np.sin(th)], -1)
+    pool = [zig, circ]
+    T = 500
+    rng = np.random.default_rng(1)
+    task_ring = np.where(rng.random(T) < 0.05, 0, 1).astype(np.int64)
+    cv = np.tile(np.array([[[0.0, 0.5], [1.0, 0.5], [1.0, 1.0],
+                            [0.0, 1.0], [0, 0], [0, 0], [0, 0]]],
+                          float), (T, 1, 1))
+    cc = np.full(T, 4)
+    got = convex_clip_tasks(pool, task_ring, cv, cc)
+    monkeypatch.setenv("MOSAIC_TPU_DISABLE_CLIP_JIT", "1")
+    want = convex_clip_tasks(pool, task_ring, cv, cc)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert (a is None) == (b is None), i
+        if a is None:
+            continue
+        assert a.shape == b.shape, i
+        if task_ring[i] == 0:
+            np.testing.assert_array_equal(a, b)
+        else:
+            np.testing.assert_allclose(a, b, rtol=0, atol=1e-9)
