@@ -46,7 +46,7 @@ from .array import GeometryArray, GeometryBuilder, GeometryType
 
 __all__ = ["boolean_op", "rings_boolean", "geometry_rings",
            "rings_to_array", "ring_signed_area", "unary_union_rings",
-           "proper_crossings"]
+           "dissolve_disjoint_rings", "proper_crossings"]
 
 
 def proper_crossings(e1: np.ndarray, e2: np.ndarray) -> np.ndarray:
@@ -95,8 +95,8 @@ def _pip_rings(points: np.ndarray, rings: Sequence[np.ndarray]) -> np.ndarray:
         if len(r) < 3:
             continue
         ax, ay = r[:, 0][None], r[:, 1][None]
-        bx = np.roll(r[:, 0], -1)[None]
-        by = np.roll(r[:, 1], -1)[None]
+        bx = np.concatenate([r[1:, 0], r[:1, 0]])[None]
+        by = np.concatenate([r[1:, 1], r[:1, 1]])[None]
         straddle = (ay <= py) != (by <= py)
         with np.errstate(divide="ignore", invalid="ignore"):
             t = (py - ay) / np.where(by == ay, 1.0, by - ay)
@@ -564,13 +564,312 @@ def pairs_intersection_area(a: GeometryArray, ia: np.ndarray,
     return out
 
 
-def unary_union_rings(parts: Sequence[Sequence[np.ndarray]]
+def _shoelace(r: np.ndarray) -> float:
+    """Signed area of an OPEN ring without np.roll round-trips."""
+    x, y = r[:, 0], r[:, 1]
+    s = x[:-1] @ y[1:] - x[1:] @ y[:-1]
+    return 0.5 * float(s + x[-1] * y[0] - x[0] * y[-1])
+
+
+#: why the last dissolve_disjoint_rings call fell back (None = it
+#: accepted) -- mirrors pip_join.LAST_DENSE_REJECT so a workload
+#: quietly losing the fast union path is diagnosable
+LAST_DISSOLVE_REJECT: Optional[str] = None
+
+
+def _dissolve_reject(reason: str) -> None:
+    global LAST_DISSOLVE_REJECT
+    LAST_DISSOLVE_REJECT = reason
+    try:
+        from ...utils.trace import tracer
+        tracer.count(f"dissolve_reject/{reason}")
+    except Exception:
+        pass
+
+
+def dissolve_disjoint_rings(parts: Sequence[Sequence[np.ndarray]],
+                            ) -> Optional[List[np.ndarray]]:
+    """Union of N even-odd regions with pairwise-disjoint INTERIORS by
+    boundary-parity cancellation — O(E log E) where the pairwise-union
+    fold is O(N · E_pair²).
+
+    The union boundary of interior-disjoint regions is exactly the
+    multiset of their directed boundary edges with opposite-direction
+    duplicates cancelled (shared cell walls between adjacent chips
+    vanish; everything else survives).  Surviving edges are stitched
+    into closed rings by leftmost-turn face walking.  Correctness is
+    VERIFIED, not assumed: area(result) must equal Σ area(parts) —
+    that identity holds iff the inputs really were interior-disjoint
+    and every shared wall cancelled bit-for-bit after snapping.  On any
+    violation (overlapping inputs, mismatched edge splits, open walk)
+    the function returns None and the caller falls back to the exact
+    pairwise fold.
+
+    This is the scalable path behind ST_UnionAgg / ST_IntersectionAgg
+    (reference: ST_UnionAgg.scala, ST_IntersectionAgg.scala:41-58):
+    their inputs are per-cell chips of one tessellation, disjoint by
+    construction.
+
+    CONTRACT: pairwise-disjoint interiors is the CALLER's guarantee.
+    The self-checks catch every *accidental* violation seen in practice
+    (edge-split mismatch, duplicated parts, unpartitioned overlap large
+    enough to move the area identity) and fall back, but adversarial
+    overlapping inputs with collinear shared boundaries can in
+    principle slip the area identity — which is why the general
+    ``unary_union_rings`` only takes this path when its caller passes
+    ``assume_disjoint=True``.
+    """
+    global LAST_DISSOLVE_REJECT
+    LAST_DISSOLVE_REJECT = None
+    # orient every part region-left (shells CCW, holes CW): then each
+    # surviving directed edge keeps the union on its LEFT, stitched
+    # rings come out correctly oriented AND nested, and no O(R²)
+    # output normalization pass is needed.  Single-ring parts (the
+    # overwhelming majority of tessellation chips) are processed as
+    # ONE flat array — per-ring shoelace via reduceat, orientation as
+    # an edge-level src/dst swap — so cost scales with vertices, not
+    # Python calls per chip.
+    singles: List[np.ndarray] = []
+    multi_rings: List[np.ndarray] = []
+    target = 0.0
+    for p in parts:
+        if not p:
+            continue
+        rr = []
+        for r in p:
+            r = np.asarray(r, np.float64)
+            if r.shape[1] > 2:
+                r = r[:, :2]
+            if len(r) >= 2 and r[0, 0] == r[-1, 0] and \
+                    r[0, 1] == r[-1, 1]:
+                r = r[:-1]
+            if len(r) >= 3:
+                rr.append(r)
+        if not rr:
+            continue
+        if len(rr) == 1:
+            singles.append(rr[0])
+        else:
+            rr = _normalize_rings(rr)
+            target += sum(_shoelace(r) for r in rr)
+            multi_rings.extend(rr)
+    if not singles and not multi_rings:
+        return []
+    seg_blocks = []
+    pts_max = 1.0
+    if singles:
+        lens = np.array([len(r) for r in singles], np.int64)
+        ptr = np.concatenate([[0], np.cumsum(lens)])
+        V = np.concatenate(singles)
+        pts_max = max(pts_max, float(np.max(np.abs(V))))
+    if multi_rings:
+        pts_max = max(pts_max, max(float(np.max(np.abs(r)))
+                                   for r in multi_rings))
+    snap = pts_max * 2.0 ** -36
+    if singles:
+        nxt = np.arange(len(V)) + 1
+        nxt[ptr[1:] - 1] = ptr[:-1]
+        x, y = V[:, 0], V[:, 1]
+        cross = x * y[nxt] - x[nxt] * y
+        areas = 0.5 * np.add.reduceat(cross, ptr[:-1])
+        target += float(np.abs(areas).sum())
+        rev = np.repeat(areas < 0, lens)          # CW ring -> swap
+        Q = np.rint(V / snap).astype(np.int64)
+        src = np.where(rev[:, None], Q[nxt], Q)
+        dst = np.where(rev[:, None], Q, Q[nxt])
+        seg_blocks.append(np.stack([src, dst], axis=1))
+    for r in multi_rings:
+        q = np.rint(r / snap).astype(np.int64)
+        qn = np.concatenate([q[1:], q[:1]])
+        seg_blocks.append(np.stack([q, qn], axis=1))
+    e = np.concatenate(seg_blocks)                # [E, 2, 2] int64
+    # Cancel + balance-check, with a bounded REPAIR loop: real datasets
+    # hand adjacent chips whose shared-wall vertices agree only to
+    # ~1e-6 deg (independent boundary computations, shallow-angle
+    # crossing amplification), which is beyond the snap quantum; those
+    # walls fail to cancel and show up as in/out-degree imbalance at
+    # two near-coincident vertices.  Merging imbalanced vertices within
+    # a small radius and re-cancelling heals them; the area identity
+    # at the end remains the arbiter of correctness.
+    dirs = None
+    for _repair in range(3):
+        e = e[np.any(e[:, 0] != e[:, 1], axis=1)]  # drop degenerate
+        if len(e) == 0:
+            if target <= snap * snap:
+                return []
+            _dissolve_reject("all_edges_degenerate")
+            return None
+        # canonical undirected key + direction sign
+        flip = (e[:, 0, 0] > e[:, 1, 0]) | (
+            (e[:, 0, 0] == e[:, 1, 0]) & (e[:, 0, 1] > e[:, 1, 1]))
+        canon = np.where(flip[:, None, None], e[:, ::-1],
+                         e).reshape(-1, 4)
+        sign = np.where(flip, -1, 1).astype(np.int64)
+        uniq, inv = np.unique(canon, axis=0, return_inverse=True)
+        net = np.zeros(len(uniq), np.int64)
+        np.add.at(net, inv, sign)
+        live = net % 2 != 0
+        if not np.any(live):
+            # everything cancelled: union of nonempty regions can't
+            # be empty unless the inputs weren't disjoint
+            if target > snap * snap:
+                _dissolve_reject("fully_cancelled")
+                return None
+            return []
+        # rebuild directed survivors (net parity ±1 → one copy)
+        lu = uniq[live]
+        ln = net[live]
+        fwd = lu.reshape(-1, 2, 2)
+        cand = np.where((ln > 0)[:, None, None], fwd, fwd[:, ::-1])
+        nv_pts = np.concatenate([cand[:, 0], cand[:, 1]])
+        verts, vid = np.unique(nv_pts, axis=0, return_inverse=True)
+        n_c = len(cand)
+        outd = np.bincount(vid[:n_c], minlength=len(verts))
+        ind = np.bincount(vid[n_c:], minlength=len(verts))
+        bad = np.nonzero(outd != ind)[0]
+        if len(bad) == 0:
+            dirs = cand
+            break
+        if len(bad) > max(64, len(verts) // 64):
+            _dissolve_reject("imbalance_too_wide")
+            return None                           # not a precision tail
+        # cluster imbalanced vertices within the heal radius and snap
+        # each cluster to its first member, then re-cancel
+        bv = verts[bad].astype(np.float64)
+        radius = 2.0 ** 13                        # in snap quanta
+        remap = {}
+        for i in range(len(bad)):
+            if int(bad[i]) in remap:
+                continue
+            d = np.max(np.abs(bv - bv[i]), axis=1)
+            members = np.nonzero(d <= radius)[0]
+            if len(members) < 2:
+                _dissolve_reject("unpaired_imbalance")
+                return None
+            for j in members:
+                remap[int(bad[j])] = verts[bad[i]]
+        flat = e.reshape(-1, 2)
+        new_flat = flat.copy()
+        for old_vid, new_pt in remap.items():
+            hit = np.all(flat == verts[old_vid], axis=1)
+            new_flat[hit] = new_pt
+        e = new_flat.reshape(-1, 2, 2)
+    if dirs is None:
+        _dissolve_reject("repair_exhausted")
+        return None
+
+    # stitch into closed rings.  Vertices get integer ids; each edge
+    # chases successor edges at its head vertex.  Degree-1 vertices
+    # (the overwhelming majority) resolve by direct lookup; junction
+    # vertices (>= 2 outgoing) resolve by sharpest-left-turn so faces
+    # stay simple.
+    nv_pts = np.concatenate([dirs[:, 0], dirs[:, 1]])
+    verts, vid = np.unique(nv_pts, axis=0, return_inverse=True)
+    n_e = len(dirs)
+    src_id, dst_id = vid[:n_e], vid[n_e:]
+    order = np.argsort(src_id, kind="stable")
+    bounds = np.searchsorted(src_id[order], np.arange(len(verts) + 1))
+    multi = {}
+    successor = np.full(len(verts), -1, np.int64)
+    for v in np.nonzero(np.diff(bounds) > 1)[0]:
+        multi[int(v)] = [int(j) for j in order[bounds[v]:bounds[v + 1]]]
+    single = np.diff(bounds) == 1
+    successor[single] = order[bounds[:-1][single]]
+    used = np.zeros(n_e, bool)
+    vecs = (dirs[:, 1] - dirs[:, 0]).astype(np.float64)
+    rings_out: List[np.ndarray] = []
+    for start in range(n_e):
+        if used[start]:
+            continue
+        walk = [start]
+        used[start] = True
+        cur = int(dst_id[start])
+        prev = start
+        guard = n_e + 1
+        while cur != src_id[start] and guard:
+            guard -= 1
+            if cur in multi:
+                cands = [j for j in multi[cur] if not used[j]]
+                if not cands:
+                    _dissolve_reject("open_walk")
+                    return None
+                if len(cands) == 1:
+                    nxt = cands[0]
+                else:
+                    pv = vecs[prev]
+
+                    def turn(j):
+                        v = vecs[j]
+                        return np.arctan2(pv[0] * v[1] - pv[1] * v[0],
+                                          pv[0] * v[0] + pv[1] * v[1])
+                    nxt = max(cands, key=turn)
+            else:
+                nxt = int(successor[cur])
+                if nxt < 0 or used[nxt]:
+                    _dissolve_reject("open_walk")
+                    return None
+            walk.append(nxt)
+            used[nxt] = True
+            prev = nxt
+            cur = int(dst_id[nxt])
+        if not guard:
+            _dissolve_reject("walk_guard")
+            return None
+        rings_out.append(dirs[walk, 0].astype(np.float64) * snap)
+    got = float(sum(_shoelace(r) for r in rings_out))
+    tol = max(abs(target), snap) * 1e-6 + pts_max * snap * 64.0
+    if abs(got - target) > tol:
+        _dissolve_reject(f"area_identity:{got:.3e}vs{target:.3e}")
+        return None
+    # orientation/depth consistency: a CCW ring must sit at even
+    # nesting depth, CW at odd.  Catches interior-disjointness
+    # violations the area identity alone cannot see (e.g. one input
+    # nested inside another: its boundary survives CCW at depth 1,
+    # where a true hole would be CW).  Only rings bbox-contained in
+    # another ring need a vote, so the usual output (one shell, few
+    # holes) costs almost nothing.
+    if len(rings_out) > 1:
+        los = np.array([r.min(axis=0) for r in rings_out])
+        his = np.array([r.max(axis=0) for r in rings_out])
+        sa = np.array([_shoelace(r) for r in rings_out])
+        area_floor = pts_max * snap * 16.0
+        for j in range(len(rings_out)):
+            if abs(sa[j]) <= area_floor:
+                continue                          # healed sliver ring
+            cand = np.nonzero(
+                np.all(los <= los[j], axis=1) &
+                np.all(his >= his[j], axis=1))[0]
+            cand = cand[cand != j]
+            if len(cand) == 0:
+                if sa[j] < 0:
+                    _dissolve_reject("cw_ring_at_depth0")
+                    return None
+                continue
+            k = min(len(rings_out[j]), 5)
+            votes = _pip_rings(rings_out[j][:k],
+                               [rings_out[c] for c in cand])
+            depth_odd = bool(np.median(votes.astype(int)) > 0.5)
+            if depth_odd == (sa[j] > 0):
+                _dissolve_reject("orientation_depth_mismatch")
+                return None
+    return rings_out
+
+
+def unary_union_rings(parts: Sequence[Sequence[np.ndarray]],
+                      assume_disjoint: bool = False
                       ) -> List[np.ndarray]:
-    """Union of N even-odd regions (fold of pairwise unions, balanced for
-    stability).  Reference: ST_UnionAgg / ST_UnaryUnion."""
+    """Union of N even-odd regions.  Fast path (only when the caller
+    asserts interior-disjoint inputs — tessellation chips keyed by
+    distinct cells): boundary-parity dissolve, O(E log E).  General
+    path: balanced fold of pairwise unions, which resolves arbitrary
+    overlaps exactly.  Reference: ST_UnionAgg / ST_UnaryUnion."""
     regs = [list(p) for p in parts if p]
     if not regs:
         return []
+    if assume_disjoint and len(regs) > 4:
+        fast = dissolve_disjoint_rings(regs)
+        if fast is not None:
+            return fast
     while len(regs) > 1:
         nxt = []
         for i in range(0, len(regs) - 1, 2):

@@ -609,23 +609,62 @@ class MosaicContext(RasterFunctions):
                 increments.append(rings_boolean(
                     geometry_rings(left.geoms, i),
                     geometry_rings(right.geoms, i), "intersection"))
-        return rings_to_array(unary_union_rings(increments))
+        # one increment per distinct cell and every increment confined
+        # to its cell => interiors disjoint => parity-dissolve union
+        uniq_cells = len(np.unique(left.cell_id)) == len(left.cell_id)
+        return rings_to_array(unary_union_rings(
+            increments, assume_disjoint=uniq_cells))
 
     def st_union_agg(self, chips: ChipSet) -> Geoms:
         """Union of all chip geometries (core chips contribute their whole
-        cell) — reference: ST_UnionAgg."""
-        from ..core.geometry.clip import (geometry_rings, rings_to_array,
+        cell) — reference: ST_UnionAgg.
+
+        Chips are confined to their cells and distinct cells have
+        disjoint interiors, so the union is a parity dissolve, not a
+        fold.  Three attempts, exactness first:
+
+        1. Dissolve over ALL chips directly.  When source geometries
+           are disjoint (the normal agg input — zones, admin areas)
+           even same-cell chips from adjacent sources are disjoint
+           with topologically clean shared borders, which the dissolve
+           cancels EXACTLY — no boolean-engine snap floor at all.
+        2. If rejected (genuinely overlapping chips): resolve each
+           duplicated cell locally with a small exact fold, then
+           dissolve across cells (disjoint by construction).
+        3. If that is rejected too: the full pairwise fold."""
+        from ..core.geometry.clip import (dissolve_disjoint_rings,
+                                          geometry_rings, rings_to_array,
                                           unary_union_rings)
         core = chips.is_core.astype(bool)
-        cellg = self.grid_boundary(chips.cell_id[core]) if core.any() \
-            else None
-        cell_at = {int(r): k for k, r in enumerate(np.nonzero(core)[0])}
-        regions = []
-        for i in range(len(chips.cell_id)):
-            if core[i]:
-                regions.append(geometry_rings(cellg, cell_at[i]))
-            else:
-                regions.append(geometry_rings(chips.geoms, i))
+        cells, inv = np.unique(chips.cell_id, return_inverse=True)
+        cell_core = np.zeros(len(cells), bool)
+        np.logical_or.at(cell_core, inv, core)
+        cellg = self.grid_boundary(cells[cell_core]) if \
+            cell_core.any() else None
+        core_at = {int(c): k
+                   for k, c in enumerate(np.nonzero(cell_core)[0])}
+        order = np.argsort(inv, kind="stable")
+        starts = np.searchsorted(inv[order], np.arange(len(cells) + 1))
+
+        def cell_region(ci, resolve):
+            if cell_core[ci]:
+                return [geometry_rings(cellg, core_at[ci])]
+            rows = order[starts[ci]:starts[ci + 1]]
+            parts = [geometry_rings(chips.geoms, int(r)) for r in rows]
+            return [unary_union_rings(parts)] if resolve and \
+                len(parts) > 1 else parts
+
+        regions = [p for ci in range(len(cells))
+                   for p in cell_region(ci, resolve=False)]
+        if len(chips.cell_id) > 4:
+            fast = dissolve_disjoint_rings(regions)
+            if fast is not None:
+                return rings_to_array(fast)
+            resolved = [p for ci in range(len(cells))
+                        for p in cell_region(ci, resolve=True)]
+            fast = dissolve_disjoint_rings(resolved)
+            if fast is not None:
+                return rings_to_array(fast)
         return rings_to_array(unary_union_rings(regions))
 
     def st_intersects_agg(self, left: ChipSet, right: ChipSet) -> bool:

@@ -26,9 +26,23 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     src = os.path.join(os.path.dirname(__file__), "geokernels.cpp")
     cache = os.path.join(tempfile.gettempdir(), "mosaic_tpu_native")
     os.makedirs(cache, exist_ok=True)
-    lib_path = os.path.join(cache, "geokernels.so")
-    if not os.path.exists(lib_path) or \
-            os.path.getmtime(lib_path) < os.path.getmtime(src):
+    # cache key = source content hash: two checkouts (worktrees, old
+    # versions) sharing a tmpdir must never serve each other a .so with
+    # a different symbol set
+    import hashlib
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    lib_path = os.path.join(cache, f"geokernels-{tag}.so")
+    if not os.path.exists(lib_path):
+        # drop artifacts of other source revisions (incl. the legacy
+        # un-hashed name) so the shared tmp dir stays bounded
+        for stale in os.listdir(cache):
+            if stale.startswith("geokernels") and \
+                    stale != os.path.basename(lib_path):
+                try:
+                    os.unlink(os.path.join(cache, stale))
+                except OSError:
+                    pass
         tmp = lib_path + ".build"
         try:
             subprocess.run(

@@ -1,0 +1,71 @@
+"""Minimal flagship-join timer for regression bisection.
+
+Reproduces exactly the bench.py steady-state flagship measurement
+(BASELINE config 1: taxi zones x 4M points, H3 res from workload) on
+CPU, printing one JSON line with device_ms / e2e_ms / uncertain_frac.
+Used to bisect the r3->r4 52% device-time regression (VERDICT task 2).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from mosaic_tpu.bench.workloads import build_workload, nyc_points
+    from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                              host_recheck_fn, localize,
+                                              make_pip_join_fn,
+                                              zone_histogram)
+
+    polys, grid, res = build_workload(n_side=16, grid_name="H3",
+                                      zones="taxi")
+    idx = build_pip_index(polys, res, grid)
+    join = make_pip_join_fn(idx, grid)
+    n_zones = len(polys)
+    recheck = host_recheck_fn(idx, polys)
+
+    def step(points):
+        zone, uncertain = join(points)
+        return zone, uncertain, zone_histogram(zone, n_zones)
+
+    stepc = jax.jit(step)
+    n = 1 << 22
+    pts64 = nyc_points(n)
+    pts = jnp.asarray(localize(idx, pts64))
+    t0 = time.time()
+    jax.block_until_ready(stepc(pts))
+    compile_s = time.time() - t0
+
+    iters = 5
+    host_batches = [nyc_points(n, seed=100 + i) for i in range(iters)]
+    batches = [jax.device_put(jnp.asarray(localize(idx, hb)))
+               for hb in host_batches]
+    jax.block_until_ready(batches)
+    dev_times, e2e_times, unc_total = [], [], 0
+    for i in range(iters):
+        t0 = time.time()
+        z, u, h = stepc(batches[i])
+        jax.block_until_ready((z, u, h))
+        t1 = time.time()
+        zh = recheck(host_batches[i], np.asarray(z), np.asarray(u))
+        t2 = time.time()
+        dev_times.append(t1 - t0)
+        e2e_times.append(t2 - t0)
+        unc_total += int(np.asarray(u).sum())
+    print(json.dumps({
+        "device_ms": round(float(np.median(dev_times)) * 1e3, 1),
+        "e2e_ms": round(float(np.median(e2e_times)) * 1e3, 1),
+        "uncertain_frac": round(unc_total / (iters * n), 8),
+        "compile_s": round(compile_s, 1),
+        "index": type(idx).__name__,
+        "num_chips": idx.num_chips,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
