@@ -451,6 +451,45 @@ def rings_boolean(rings_a: Sequence[np.ndarray],
     return out
 
 
+
+def _sample_parity(rings, los, his, K: int = 5):
+    """Per-ring nesting parity by K strided sample vertices, plus the
+    containers of each ring's first sample.
+
+    Container-major: each ring is iterated ONCE as a container and all
+    other rings' samples inside its bbox are batched through one
+    crossing-parity pass — O(sum V_i * P_i) where the ring-major
+    version is O(R^2 * V) (measured 29 s on a 2k-ring county union).
+    Returns (parity [R, K] bool, n_samples [R], first_in dict
+    ring -> list of containers of its first sample vertex)."""
+    nr = len(rings)
+    samp = np.zeros((nr, K, 2))
+    skn = np.zeros(nr, np.int64)
+    for j, r in enumerate(rings):
+        k = min(len(r), K)
+        idx = (np.arange(k) * max(1, len(r) // k))[:k] % len(r)
+        samp[j, :k] = r[idx]
+        skn[j] = k
+    flat = samp.reshape(-1, 2)
+    ok_pt = (np.arange(K)[None, :] < skn[:, None]).reshape(-1)
+    owner = np.repeat(np.arange(nr), K)
+    parity = np.zeros(len(flat), bool)
+    first_in: dict = {j: [] for j in range(nr)}
+    for i, r in enumerate(rings):
+        inb = (ok_pt & (owner != i) &
+               (flat[:, 0] >= los[i, 0]) & (flat[:, 0] <= his[i, 0]) &
+               (flat[:, 1] >= los[i, 1]) & (flat[:, 1] <= his[i, 1]))
+        sel = np.nonzero(inb)[0]
+        if not len(sel):
+            continue
+        hit = _pip_rings(flat[sel], [r])
+        parity[sel] ^= hit
+        for p in sel[hit]:
+            if p % K == 0:
+                first_in[p // K].append(i)
+    return parity.reshape(nr, K), skn, first_in
+
+
 def rings_to_array(rings: Sequence[np.ndarray], srid: int = 4326,
                    builder: Optional[GeometryBuilder] = None,
                    empty_ok: bool = True) -> Optional[GeometryArray]:
@@ -465,22 +504,20 @@ def rings_to_array(rings: Sequence[np.ndarray], srid: int = 4326,
         if empty_ok:
             b.add(GeometryType.POLYGON, [[np.zeros((0, 2))]])
         return b.finish() if own else None
-    depth = []
-    for i, r in enumerate(rings):
-        others = [q for j, q in enumerate(rings) if j != i]
-        k = min(len(r), 5)
-        votes = _pip_rings(r[:k], others) if others else np.zeros(k, bool)
-        depth.append(int(np.median(votes.astype(int)) > 0.5))
+    nr = len(rings)
+    los = np.array([r.min(axis=0) for r in rings])
+    his = np.array([r.max(axis=0) for r in rings])
+    parity, skn, first_in = _sample_parity(rings, los, his)
+    depth = [int(np.median(parity[j, :skn[j]].astype(int)) > 0.5)
+             for j in range(nr)]
     shells = [i for i, d in enumerate(depth) if d == 0]
+    shell_set = set(shells)
     holes_of = {i: [] for i in shells}
     for i, d in enumerate(depth):
         if d == 0:
             continue
         # assign hole to the smallest-area shell containing it
-        cands = []
-        for s in shells:
-            if _pip_rings(rings[i][:1], [rings[s]])[0]:
-                cands.append(s)
+        cands = [s for s in first_in[i] if s in shell_set]
         if cands:
             s = min(cands, key=lambda j: abs(ring_signed_area(rings[j])))
             holes_of[s].append(i)
@@ -775,21 +812,34 @@ def dissolve_disjoint_rings(parts: Sequence[Sequence[np.ndarray]],
         multi[int(v)] = [int(j) for j in order[bounds[v]:bounds[v + 1]]]
     single = np.diff(bounds) == 1
     successor[single] = order[bounds[:-1][single]]
-    used = np.zeros(n_e, bool)
     vecs = (dirs[:, 1] - dirs[:, 0]).astype(np.float64)
+    # edge -> next edge for edges whose head is a degree-1 vertex
+    # (-1 marks a junction head).  Python lists make the chase a pure
+    # int-op loop (~100 ns/step): a county-scale dissolve walks ~1M
+    # steps, which np scalar indexing made a 30+ s stage (BENCH r5
+    # first cut measured union_agg at 38 s on 93k chips).
+    # successor is -1 at every vertex whose out-degree != 1, so the
+    # chase array is already -1 exactly at junction/dead-end heads
+    chase_l = successor[dst_id].tolist()
+    src_l = src_id.tolist()
+    dst_l = dst_id.tolist()
+    used = [False] * n_e
     rings_out: List[np.ndarray] = []
     for start in range(n_e):
         if used[start]:
             continue
         walk = [start]
         used[start] = True
-        cur = int(dst_id[start])
+        home = src_l[start]
         prev = start
+        cur = dst_l[start]
         guard = n_e + 1
-        while cur != src_id[start] and guard:
+        while cur != home and guard:
             guard -= 1
-            if cur in multi:
-                cands = [j for j in multi[cur] if not used[j]]
+            nxt = chase_l[prev]
+            if nxt < 0:                  # junction (or dead-end) vertex
+                cands = [j for j in multi.get(cur, ())
+                         if not used[j]]
                 if not cands:
                     _dissolve_reject("open_walk")
                     return None
@@ -803,15 +853,13 @@ def dissolve_disjoint_rings(parts: Sequence[Sequence[np.ndarray]],
                         return np.arctan2(pv[0] * v[1] - pv[1] * v[0],
                                           pv[0] * v[0] + pv[1] * v[1])
                     nxt = max(cands, key=turn)
-            else:
-                nxt = int(successor[cur])
-                if nxt < 0 or used[nxt]:
-                    _dissolve_reject("open_walk")
-                    return None
+            elif used[nxt]:
+                _dissolve_reject("open_walk")
+                return None
             walk.append(nxt)
             used[nxt] = True
             prev = nxt
-            cur = int(dst_id[nxt])
+            cur = dst_l[nxt]
         if not guard:
             _dissolve_reject("walk_guard")
             return None
@@ -829,26 +877,17 @@ def dissolve_disjoint_rings(parts: Sequence[Sequence[np.ndarray]],
     # another ring need a vote, so the usual output (one shell, few
     # holes) costs almost nothing.
     if len(rings_out) > 1:
+        nr = len(rings_out)
         los = np.array([r.min(axis=0) for r in rings_out])
         his = np.array([r.max(axis=0) for r in rings_out])
         sa = np.array([_shoelace(r) for r in rings_out])
         area_floor = pts_max * snap * 16.0
-        for j in range(len(rings_out)):
+        parity, skn, _ = _sample_parity(rings_out, los, his)
+        for j in range(nr):
             if abs(sa[j]) <= area_floor:
                 continue                          # healed sliver ring
-            cand = np.nonzero(
-                np.all(los <= los[j], axis=1) &
-                np.all(his >= his[j], axis=1))[0]
-            cand = cand[cand != j]
-            if len(cand) == 0:
-                if sa[j] < 0:
-                    _dissolve_reject("cw_ring_at_depth0")
-                    return None
-                continue
-            k = min(len(rings_out[j]), 5)
-            votes = _pip_rings(rings_out[j][:k],
-                               [rings_out[c] for c in cand])
-            depth_odd = bool(np.median(votes.astype(int)) > 0.5)
+            depth_odd = bool(np.median(
+                parity[j, :skn[j]].astype(int)) > 0.5)
             if depth_odd == (sa[j] > 0):
                 _dissolve_reject("orientation_depth_mismatch")
                 return None

@@ -252,6 +252,11 @@ def _parity_block(eg: np.ndarray, px: np.ndarray, py: np.ndarray,
     b, q = px.shape
     if _f64_jit_enabled():
         import jax.numpy as jnp
+        # pad rows to a pow2 no larger than the caller's block: a
+        # 100-pair bucket of 4096-edge geometries must not compute a
+        # 4096-row kernel (40x waste, round-5 real-zone profile);
+        # pow2 keeps the compile count bounded
+        block = min(block, 1 << int(np.ceil(np.log2(max(b, 64)))))
         key = (block, eg.shape[1], q)
         fn = _PARITY_JIT.get(key)
         if fn is None:
