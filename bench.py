@@ -358,7 +358,7 @@ def main():
     ports = np.stack([
         rngk.uniform(-180, 180, 3000),
         np.degrees(np.arcsin(rngk.uniform(-0.98, 0.98, 3000)))], -1)
-    n_pings = 1 << 20 if on_tpu else 1 << 17
+    n_pings = 1 << 20               # the >=1M-row line (VERDICT r4 #6)
     ctr = ports[rngk.integers(0, len(ports), n_pings)]
     pings = ctr + rngk.normal(0, 1.5, (n_pings, 2))
     pings[:, 1] = np.clip(pings[:, 1], -88, 88)

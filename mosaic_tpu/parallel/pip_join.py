@@ -365,6 +365,12 @@ class DensePIPIndex:
     entry: jnp.ndarray
     pool: jnp.ndarray
     gzones: jnp.ndarray
+    #: [G] bool — group's chip edges exceed the pool width (a complex
+    #: coastline cell): every point landing there is flagged uncertain
+    #: and resolved by the exact f64 host recheck, so ONE wide cell
+    #: cannot pad the whole pool (real NYC zones: max 308 edges vs
+    #: mean 19 made the kernel 12x slower than the synthetic bench)
+    gwide: jnp.ndarray
     origin: np.ndarray
     face0: int
     a0: int
@@ -380,7 +386,7 @@ class DensePIPIndex:
     aux: Optional[dict] = None
 
     def tree_flatten(self):
-        return ((self.entry, self.pool, self.gzones),
+        return ((self.entry, self.pool, self.gzones, self.gwide),
                 (self.origin.tobytes(), self.face0, self.a0, self.b0,
                  self.W, self.H, self.res, self.err_lattice,
                  self.n_zones, self.ext_deg))
@@ -515,10 +521,19 @@ def build_dense_pip_index(polys: GeometryArray, res: int, grid,
     G = len(ucells)
     gidx = np.searchsorted(ucells, b_cells)              # chip -> group
     gedges = np.bincount(gidx, weights=cnt).astype(np.int64)
+    # pool width covers the 98th-percentile group; wider groups are
+    # truncated and their cells flagged always-uncertain (host f64
+    # resolves them exactly) — one pathological cell must not pad the
+    # kernel for every point
+    emax = int(gedges.max()) if G else 0
+    etarget = int(max(np.quantile(gedges, 0.98), 8)) if G else 8
     E = 8
-    while E < gedges.max():
+    while E < min(emax, etarget):
         E *= 2
-    if E > 512:
+    E = min(E, 512)
+    gwide_np = gedges > E
+    if G and float(gwide_np.mean()) > 0.2:
+        # most cells would bounce to host: dense is the wrong shape
         _dense_reject("pathological_cell")
         return None
 
@@ -554,11 +569,13 @@ def build_dense_pip_index(polys: GeometryArray, res: int, grid,
     pool[..., 4] = -1.0
     loc_a = flat_a - origin[None]
     loc_b = flat_b - origin[None]
-    pool[edge_group, pos, 0] = loc_a[:, 0].astype(np.float32)
-    pool[edge_group, pos, 1] = loc_a[:, 1].astype(np.float32)
-    pool[edge_group, pos, 2] = loc_b[:, 0].astype(np.float32)
-    pool[edge_group, pos, 3] = loc_b[:, 1].astype(np.float32)
-    pool[edge_group, pos, 4] = edge_zslot.astype(np.float32)
+    fits = pos < E                       # wide-group overflow truncated
+    eg, ep = edge_group[fits], pos[fits]
+    pool[eg, ep, 0] = loc_a[fits, 0].astype(np.float32)
+    pool[eg, ep, 1] = loc_a[fits, 1].astype(np.float32)
+    pool[eg, ep, 2] = loc_b[fits, 0].astype(np.float32)
+    pool[eg, ep, 3] = loc_b[fits, 1].astype(np.float32)
+    pool[eg, ep, 4] = edge_zslot[fits].astype(np.float32)
 
     prec = pick_precision(precision)
     ext_deg = float(ext) + 0.1
@@ -581,7 +598,9 @@ def build_dense_pip_index(polys: GeometryArray, res: int, grid,
     }
     return DensePIPIndex(
         entry=jnp.asarray(entry), pool=jnp.asarray(pool),
-        gzones=jnp.asarray(gzones), origin=origin, face0=face0,
+        gzones=jnp.asarray(gzones),
+        gwide=jnp.asarray(np.resize(gwide_np, max(G, 1))),
+        origin=origin, face0=face0,
         a0=a0, b0=b0, W=W, H=H, res=res, err_lattice=float(err),
         n_zones=len(polys), ext_deg=ext_deg, aux=aux)
 
@@ -679,8 +698,9 @@ def make_dense_pip_join_fn(idx: DensePIPIndex, eps: float = EPS_EDGE_DEG,
             jnp.int32(-1))
 
         zone = jnp.where(is_core, zone_core, zone_border)
+        wide = idx.gwide[g] & is_border
         uncertain = (margin < np.float32(err_lat)) | \
-            (facegap < np.float32(FACEGAP_EPS)) | edge_flag
+            (facegap < np.float32(FACEGAP_EPS)) | edge_flag | wide
         zone = jnp.where(far, jnp.int32(-1), zone)
         uncertain = uncertain & ~far
         return zone, uncertain

@@ -47,7 +47,13 @@ def _area(rings):
 
 @pytest.mark.parametrize("name,cx,cy,scale", REGIMES)
 def test_boolean_identities(name, cx, cy, scale):
-    rng = np.random.default_rng(abs(hash(name)) % 2 ** 31)
+    # crc32, NOT hash(): str hashes are salted per process, which made
+    # this fuzz a different workload every run — the round-4 "1/359
+    # unreproduced flake" was a rare seed landing outside the
+    # tolerance envelope, unfindable because the seed died with the
+    # process
+    import zlib
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
     ba, bb = GeometryBuilder(), GeometryBuilder()
     P = 40
     for _ in range(P):
@@ -68,7 +74,12 @@ def test_boolean_identities(name, cx, cy, scale):
     # rings_boolean's tolerance note).  The kernel cross-check stays
     # tight — it shares no stitching.
     mag = max(abs(cx), abs(cy), 1.0)
-    ident_rel = max(1e-9, 4e-6 * min(1.0, 1e-2 * mag / scale))
+    # identity error is f64 shoelace cancellation: terms ~mag^2 summed
+    # to an area ~scale^2, so rel err ~ eps * (mag/scale)^2.  Measured
+    # worst over 60 seeds x 40 pairs: 4.3e-5 at mag/scale 7.4e4
+    # (~8e-15 * ratio^2); 5e-14 gives ~6x margin.  The old 4e-6
+    # envelope undershot this regime — the round-4 flake.
+    ident_rel = max(1e-9, 5e-14 * (mag / scale) ** 2)
     # engine-vs-kernel: both are exact selections of the same split
     # points but sum shoelace terms (~mag^2 each) in different orders,
     # so the comparison floor is the f64 cancellation bound ~1e-15*mag^2

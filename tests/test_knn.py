@@ -21,6 +21,16 @@ def grid():
     return get_index_system("H3")
 
 
+#: both KNN engines must satisfy the same oracle: the round-5 device
+#: brute pass (right side small -> one all-pairs top_k) and the ring
+#: march (brute_right_max=0 forces it — the path large right sides
+#: and mesh-sharded runs take)
+ENGINES = [
+    pytest.param({}, id="brute"),
+    pytest.param({"brute_right_max": 0}, id="rings"),
+]
+
+
 def _pts(n, seed, bbox=NYC):
     rng = np.random.default_rng(seed)
     return np.stack([rng.uniform(bbox[0], bbox[2], n),
@@ -36,30 +46,35 @@ def _check_against_oracle(out, left, right, k, thr=None):
     assert not np.any(np.isfinite(out["distance"]) ^ both)
 
 
-def test_knn_matches_bruteforce(grid):
+@pytest.mark.parametrize("eng", ENGINES)
+def test_knn_matches_bruteforce(grid, eng):
     left = _pts(2000, 1)
     right = _pts(300, 2)
-    knn = SpatialKNN(grid, k=5, index_resolution=7, max_iterations=32)
+    knn = SpatialKNN(grid, k=5, index_resolution=7, max_iterations=32,
+                     **eng)
     out = knn.transform(left, right)
     _check_against_oracle(out, left, right, 5)
     assert out["iterations"] < 32          # early stop engaged
 
 
-def test_knn_k_larger_than_candidates_nearby(grid):
+@pytest.mark.parametrize("eng", ENGINES)
+def test_knn_k_larger_than_candidates_nearby(grid, eng):
     """k larger than any cell's population forces multi-ring search."""
     left = _pts(500, 3)
     right = _pts(40, 4)
-    knn = SpatialKNN(grid, k=7, index_resolution=8, max_iterations=64)
+    knn = SpatialKNN(grid, k=7, index_resolution=8, max_iterations=64,
+                     **eng)
     out = knn.transform(left, right)
     _check_against_oracle(out, left, right, 7)
 
 
-def test_knn_distance_threshold(grid):
+@pytest.mark.parametrize("eng", ENGINES)
+def test_knn_distance_threshold(grid, eng):
     left = _pts(800, 5)
     right = _pts(200, 6)
     thr = 0.02
     knn = SpatialKNN(grid, k=4, index_resolution=8, max_iterations=64,
-                     distance_threshold=thr)
+                     distance_threshold=thr, **eng)
     out = knn.transform(left, right)
     _check_against_oracle(out, left, right, 4, thr)
     # some rows must be truncated by the threshold for the test to bite
@@ -73,12 +88,14 @@ def test_knn_checkpoint_resume(grid, tmp_path):
     ref = SpatialKNN(grid, k=3, index_resolution=8,
                      max_iterations=64).transform(left, right)
     # interrupted run: stop after 2 rings, then resume from checkpoint
+    # (ring engine forced: checkpoint/resume is iteration-state
+    # machinery, which the one-shot brute pass never touches)
     ck = CheckpointManager(str(tmp_path / "ck"))
     knn1 = SpatialKNN(grid, k=3, index_resolution=8, max_iterations=2,
-                      checkpoint=ck)
+                      checkpoint=ck, brute_right_max=0)
     knn1.transform(left, right)
     knn2 = SpatialKNN(grid, k=3, index_resolution=8, max_iterations=64,
-                      checkpoint=ck)
+                      checkpoint=ck, brute_right_max=0)
     out = knn2.transform(left, right)
     assert np.array_equal(out["right_id"], ref["right_id"])
 
@@ -96,17 +113,19 @@ def test_knn_sharded_8dev(grid):
     _check_against_oracle(out, left, right, 5)
 
 
-def test_knn_small_right_side(grid):
+@pytest.mark.parametrize("eng", ENGINES)
+def test_knn_small_right_side(grid, eng):
     """k larger than the whole right set: pad with -1, no crash."""
     left = _pts(50, 11)
     right = _pts(2, 12)
     out = SpatialKNN(grid, k=5, index_resolution=8,
-                     max_iterations=64).transform(left, right)
+                     max_iterations=64, **eng).transform(left, right)
     _check_against_oracle(out, left, right, 5)
     assert np.all(out["right_id"][:, 2:] == -1)
 
 
-def test_knn_vertex_anchored_left_points(grid):
+@pytest.mark.parametrize("eng", ENGINES)
+def test_knn_vertex_anchored_left_points(grid, eng):
     """Left points sitting ON cell vertices — the worst case for the
     ring separation floor (regression: the d*2*inradius bound was loose
     along hex-vertex directions and returned a non-nearest neighbour
@@ -117,13 +136,14 @@ def test_knn_vertex_anchored_left_points(grid):
     verts, counts = grid.cell_boundary(cells)
     left = verts.reshape(-1, 2)[:256]
     out = SpatialKNN(grid, k=3, index_resolution=8,
-                     max_iterations=64).transform(left, right)
+                     max_iterations=64, **eng).transform(left, right)
     _check_against_oracle(out, left, right, 3)
 
 
 # ------------------------- round-4 generality: faces / grids / geoms
 
-def test_knn_global_extent_multi_face(grid):
+@pytest.mark.parametrize("eng", ENGINES)
+def test_knn_global_extent_multi_face(grid, eng):
     """BASELINE config 4 shape: pings x ports at GLOBAL extent — the
     right side spans many icosahedron faces; results must still be
     exact vs brute force (per-face windows + cross-face host pass)."""
@@ -136,7 +156,8 @@ def test_knn_global_extent_multi_face(grid):
     pings = np.stack([rng.uniform(-180, 180, 3000),
                       np.degrees(np.arcsin(rng.uniform(-1, 1, 3000)))],
                      -1)
-    knn = SpatialKNN(grid, k=4, index_resolution=4, max_iterations=64)
+    knn = SpatialKNN(grid, k=4, index_resolution=4, max_iterations=64,
+                     **eng)
     out = knn.transform(pings, ports)
     _check_against_oracle(out, pings, ports, 4)
     # the device path must do real work: most rows resolve on device
