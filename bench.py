@@ -271,6 +271,9 @@ def main():
             [[cx - w_, cy - h_], [cx + w_, cy - h_], [cx + w_, cy + h_],
              [cx - w_, cy + h_], [cx - w_, cy - h_]]))
     foot = fb.finish()
+    # warm the overlay kernels on a 3-row slice (compile amortization,
+    # same convention as the flagship/counties stages)
+    overlay_intersects(foot.take([0, 1, 2]), polys, res, grid)
     t0 = time.time()
     ov = overlay_intersects(foot, polys, res, grid)
     t_overlay = time.time() - t0
@@ -279,6 +282,7 @@ def main():
         f"{t_overlay:.2f}s; parity mismatches {ov_mism}")
     # round-4: ragged pair emission + distributed intersection AREA
     from mosaic_tpu.parallel.overlay import overlay_intersection_area
+    overlay_intersection_area(foot.take([0, 1, 2]), polys, res, grid)
     t0 = time.time()
     oa_ga, oa_gb, oa_area = overlay_intersection_area(foot, polys, res,
                                                       grid)
@@ -305,6 +309,8 @@ def main():
     yy, xx = np.mgrid[0:800, 0:1000]
     dem = RasterTile((np.sin(xx / 60.0) * 50 + yy * 0.1)[None], gtr,
                      srid=4326)
+    small = RasterTile(dem.data[:, :64, :64], gtr, srid=4326)
+    raster_to_grid([small], 8, grid, combiner="avg")
     t0 = time.time()
     r2g = raster_to_grid([dem], 8, grid, combiner="avg")
     t_r2g = time.time() - t0
@@ -319,6 +325,8 @@ def main():
     from mosaic_tpu.core.geometry.geojson import read_geojson
     feats = [json.loads(l) for l in open(_zp) if l.strip()]
     rzones = read_geojson([json.dumps(f["geometry"]) for f in feats])
+    # warm the big-ring clip/classify buckets real polygons hit
+    tessellate(rzones.take([0, 1]), 9, grid, keep_core_geom=False)
     t0 = time.time()
     rchips = tessellate(rzones, 9, grid, keep_core_geom=False)
     t_real_tess = time.time() - t0
