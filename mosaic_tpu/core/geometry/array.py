@@ -203,9 +203,17 @@ class GeometryArray:
         """[P] uint8 member type per part: the stored ``part_types`` when
         present, else the row type broadcast to its parts (multis map to
         their member type; collections without stored types stay
-        GEOMETRYCOLLECTION = "unknown member")."""
+        GEOMETRYCOLLECTION = "unknown member").
+
+        Cached on the (immutable) array: per-row callers — e.g. the
+        pairwise distance loop — otherwise rebuild the full [P] array
+        per row, turning an O(V) pass into O(G·P) (measured 219 s for
+        a 23.7k-pair batch)."""
         if self.part_types is not None:
             return self.part_types
+        cached = getattr(self, "_ptype_eff_cache", None)
+        if cached is not None:
+            return cached
         multi_to_single = {int(GeometryType.MULTIPOINT):
                            int(GeometryType.POINT),
                            int(GeometryType.MULTILINESTRING):
@@ -214,7 +222,12 @@ class GeometryArray:
                            int(GeometryType.POLYGON)}
         per_geom = np.asarray([multi_to_single.get(int(t), int(t))
                                for t in self.types], np.uint8)
-        return np.repeat(per_geom, np.diff(self.geom_offsets))
+        out = np.repeat(per_geom, np.diff(self.geom_offsets))
+        try:
+            object.__setattr__(self, "_ptype_eff_cache", out)
+        except AttributeError:
+            pass
+        return out
 
     # -------------------------------------------------------- python view
     def geom_slices(self, i: int) -> Tuple[GeometryType, List[List[np.ndarray]]]:
