@@ -80,15 +80,8 @@ def _tm_forward(lon, lat, a, f, lon0, lat0, k0, fe, fn):
 def _tm_inverse(x, y, a, f, lon0, lat0, k0, fe, fn):
     e2 = f * (2 - f)
     ep2 = e2 / (1 - e2)
-    e1 = (1 - math.sqrt(1 - e2)) / (1 + math.sqrt(1 - e2))
     m0 = _meridian_arc(np.asarray(math.radians(lat0)), a, e2)
-    m = m0 + (y - fn) / k0
-    mu = m / (a * (1 - e2 / 4 - 3 * e2 * e2 / 64 -
-                   5 * e2 ** 3 / 256))
-    phi1 = (mu + (3 * e1 / 2 - 27 * e1 ** 3 / 32) * np.sin(2 * mu) +
-            (21 * e1 ** 2 / 16 - 55 * e1 ** 4 / 32) * np.sin(4 * mu) +
-            (151 * e1 ** 3 / 96) * np.sin(6 * mu) +
-            (1097 * e1 ** 4 / 512) * np.sin(8 * mu))
+    phi1 = _footpoint_lat(m0 + (y - fn) / k0, a, e2)
     n1 = a / np.sqrt(1 - e2 * np.sin(phi1) ** 2)
     r1 = a * (1 - e2) / (1 - e2 * np.sin(phi1) ** 2) ** 1.5
     t1 = np.tan(phi1) ** 2
@@ -103,6 +96,17 @@ def _tm_inverse(x, y, a, f, lon0, lat0, k0, fe, fn):
            (5 - 2 * c1 + 28 * t1 - 3 * c1 * c1 + 8 * ep2 +
             24 * t1 * t1) * d ** 5 / 120) / np.cos(phi1)
     return np.degrees(lam) + lon0, np.degrees(phi)
+
+
+def _footpoint_lat(M, a, e2):
+    """Footpoint latitude from a meridian-arc distance (rectifying
+    series, EPSG GN7-2) — shared by the TM and Cassini inverses."""
+    e1 = (1 - math.sqrt(1 - e2)) / (1 + math.sqrt(1 - e2))
+    mu = M / (a * (1 - e2 / 4 - 3 * e2 * e2 / 64 - 5 * e2 ** 3 / 256))
+    return (mu + (3 * e1 / 2 - 27 * e1 ** 3 / 32) * np.sin(2 * mu) +
+            (21 * e1 ** 2 / 16 - 55 * e1 ** 4 / 32) * np.sin(4 * mu) +
+            (151 * e1 ** 3 / 96) * np.sin(6 * mu) +
+            (1097 * e1 ** 4 / 512) * np.sin(8 * mu))
 
 
 def _meridian_arc(phi, a, e2):
@@ -166,7 +170,7 @@ def _osgb_to_wgs84_lonlat(lon, lat):
 
 # ------------------------------------------- generic projection engine
 # (round-5) Table-driven forward/inverse for EVERY EPSG projected CRS
-# whose method is implemented — 4,940 codes extracted from the PROJ
+# whose method is implemented — 5,053 codes extracted from the PROJ
 # EPSG registry into epsg_params.npz (tools/build_epsg_params.py).
 # Formulas follow EPSG Guidance Note 7-2.  Reference counterpart:
 # MosaicGeometry.transformCRSXY via proj4j (MosaicGeometry.scala:
@@ -508,6 +512,111 @@ def _sterea_inverse(x, y, p):
     return lon, np.degrees(phi)
 
 
+def _cassini_forward(lon, lat, p):
+    e2 = p["f"] * (2 - p["f"])
+    ep2 = e2 / (1 - e2)
+    phi = np.radians(lat)
+    lam = np.radians(lon - p["lon0"])
+    A = lam * np.cos(phi)
+    T = np.tan(phi) ** 2
+    C = ep2 * np.cos(phi) ** 2
+    nu = p["a"] / np.sqrt(1 - e2 * np.sin(phi) ** 2)
+    M = _meridian_arc(phi, p["a"], e2)
+    M0 = _meridian_arc(np.asarray(math.radians(p["lat0"])), p["a"], e2)
+    x = p["fe"] + nu * (A - T * A ** 3 / 6 -
+                        (8 - T + 8 * C) * T * A ** 5 / 120)
+    y = p["fn"] + M - M0 + nu * np.tan(phi) * (
+        A * A / 2 + (5 - T + 6 * C) * A ** 4 / 24)
+    return x, y
+
+
+def _cassini_inverse(x, y, p):
+    e2 = p["f"] * (2 - p["f"])
+    ep2 = e2 / (1 - e2)
+    a = p["a"]
+    M0 = _meridian_arc(np.asarray(math.radians(p["lat0"])), a, e2)
+    phi1 = _footpoint_lat(M0 + (y - p["fn"]), a, e2)
+    T1 = np.tan(phi1) ** 2
+    nu1 = a / np.sqrt(1 - e2 * np.sin(phi1) ** 2)
+    rho1 = a * (1 - e2) / (1 - e2 * np.sin(phi1) ** 2) ** 1.5
+    D = (x - p["fe"]) / nu1
+    phi = phi1 - (nu1 * np.tan(phi1) / rho1) * (
+        D * D / 2 - (1 + 3 * T1) * D ** 4 / 24)
+    lam = (D - T1 * D ** 3 / 3 +
+           (1 + 3 * T1) * T1 * D ** 5 / 15) / np.cos(phi1)
+    return np.degrees(lam) + p["lon0"], np.degrees(phi)
+
+
+def _hom_consts(p):
+    """Hotine Oblique Mercator shared constants (EPSG 9812/9815).
+    slots: lat0=latc, lon0=lonc, sp1=azimuth, sp2=gamma_c, k0=kc."""
+    e2 = p["f"] * (2 - p["f"])
+    e = math.sqrt(e2)
+    phic = math.radians(p["lat0"])
+    alc = math.radians(p["sp1"])
+    kc = p["k0"]
+    B = math.sqrt(1 + e2 * math.cos(phic) ** 4 / (1 - e2))
+    A = p["a"] * B * kc * math.sqrt(1 - e2) / \
+        (1 - e2 * math.sin(phic) ** 2)
+    t0 = float(_ts(np.asarray(phic), e))
+    D = B * math.sqrt(1 - e2) / (
+        math.cos(phic) * math.sqrt(1 - e2 * math.sin(phic) ** 2))
+    D2 = max(D * D, 1.0)
+    F = D + math.copysign(math.sqrt(D2 - 1.0), phic)
+    H = F * t0 ** B
+    G = (F - 1.0 / F) / 2.0
+    g0 = math.asin(min(max(math.sin(alc) / D, -1.0), 1.0))
+    lam0 = math.radians(p["lon0"]) - math.asin(
+        min(max(G * math.tan(g0), -1.0), 1.0)) / B
+    # variant-B offset of the projection centre along the u axis
+    uc = (A / B) * math.atan2(math.sqrt(D2 - 1.0), math.cos(alc))
+    uc = math.copysign(uc, phic)
+    return e, B, A, H, g0, lam0, uc
+
+
+def _hom_forward(lon, lat, p):
+    e, B, A, H, g0, lam0, uc = _hom_consts(p)
+    gc = math.radians(p["sp2"])
+    t = _ts(np.radians(lat), e)
+    Q = H / t ** B
+    S = (Q - 1.0 / Q) / 2.0
+    T = (Q + 1.0 / Q) / 2.0
+    dl = B * (np.radians(lon) - lam0)
+    # keep the skew longitude in (-pi, pi]
+    dl = (dl + np.pi) % (2 * np.pi) - np.pi
+    V = np.sin(dl)
+    U = (-V * math.cos(g0) + S * math.sin(g0)) / T
+    v = A * np.log((1 - U) / (1 + U)) / (2 * B)
+    u = A * np.arctan2(S * math.cos(g0) + V * math.sin(g0),
+                       np.cos(dl)) / B
+    if p["method"] == 9815:
+        u = u - uc
+    x = v * math.cos(gc) + u * math.sin(gc) + p["fe"]
+    y = u * math.cos(gc) - v * math.sin(gc) + p["fn"]
+    return x, y
+
+
+def _hom_inverse(x, y, p):
+    e, B, A, H, g0, lam0, uc = _hom_consts(p)
+    gc = math.radians(p["sp2"])
+    xp = x - p["fe"]
+    yp = y - p["fn"]
+    v = xp * math.cos(gc) - yp * math.sin(gc)
+    u = yp * math.cos(gc) + xp * math.sin(gc)
+    if p["method"] == 9815:
+        u = u + uc
+    Q = np.exp(-B * v / A)
+    S = (Q - 1.0 / Q) / 2.0
+    T = (Q + 1.0 / Q) / 2.0
+    V = np.sin(B * u / A)
+    U = (V * math.cos(g0) + S * math.sin(g0)) / T
+    t = (H / np.sqrt((1 + U) / (1 - U))) ** (1.0 / B)
+    lat = np.degrees(_phi_from_ts(t, e))
+    lam = lam0 - np.arctan2(S * math.cos(g0) - V * math.sin(g0),
+                            np.cos(B * u / A)) / B
+    return np.degrees(lam), lat
+
+
 def _generic_forward(lon, lat, p):
     """(lon, lat on the CRS's own datum/PM, degrees) -> native units."""
     m = p["method"]
@@ -519,6 +628,13 @@ def _generic_forward(lon, lat, p):
         x, y = x + p["fe"], y + p["fn"]
     elif m in (9801, 9802):
         x, y = _lcc_forward(lon, lat, p)
+    elif m == 9826:                      # LCC 1SP, westing axis
+        xe, y = _lcc_forward(lon, lat, dict(p, method=9801, fe=0.0))
+        x = p["fe"] - xe
+    elif m == 9806:
+        x, y = _cassini_forward(lon, lat, p)
+    elif m in (9812, 9815):
+        x, y = _hom_forward(lon, lat, p)
     elif m == 9822:
         x, y = _albers_forward(lon, lat, p)
     elif m in (9804, 9805):
@@ -546,6 +662,13 @@ def _generic_inverse(x, y, p):
                            p["lat0"], p["k0"], 0.0, 0.0)
     if m in (9801, 9802):
         return _lcc_inverse(x, y, p)
+    if m == 9826:
+        return _lcc_inverse(p["fe"] - x, y,
+                            dict(p, method=9801, fe=0.0))
+    if m == 9806:
+        return _cassini_inverse(x, y, p)
+    if m in (9812, 9815):
+        return _hom_inverse(x, y, p)
     if m == 9822:
         return _albers_inverse(x, y, p)
     if m in (9804, 9805):
@@ -634,7 +757,7 @@ def _to_4326(xy: np.ndarray, epsg: int) -> np.ndarray:
         if p is None:
             raise ValueError(
                 f"unsupported source EPSG {epsg} (analytic: 4326, "
-                "3857, 27700, UTM 326xx/327xx; table-driven: 4,940 "
+                "3857, 27700, UTM 326xx/327xx; table-driven: 5,053 "
                 "projected codes in epsg_params.npz)")
         lon, lat = _generic_inverse(x, y, p)
         lon, lat = _datum_to_wgs84(lon, lat, p)
@@ -657,7 +780,7 @@ def _from_4326(ll: np.ndarray, epsg: int) -> np.ndarray:
         if p is None:
             raise ValueError(
                 f"unsupported target EPSG {epsg} (analytic: 4326, "
-                "3857, 27700, UTM 326xx/327xx; table-driven: 4,940 "
+                "3857, 27700, UTM 326xx/327xx; table-driven: 5,053 "
                 "projected codes in epsg_params.npz)")
         lon2, lat2 = _wgs84_to_datum(lon, lat, p)
         x, y = _generic_forward(lon2, lat2, p)

@@ -2,9 +2,10 @@
 
 The table-driven engine (crs.py generic engine + epsg_params.npz,
 built by tools/build_epsg_params.py from the PROJ EPSG registry)
-covers 4,940 projected CRSs across LCC 1SP/2SP, Albers, Mercator A/B,
-TM (+South Orientated), Polar Stereographic A/B, Oblique
-Stereographic, and LAEA.  Reference counterpart: proj4j-backed
+covers 5,053 projected CRSs across LCC 1SP/2SP (+West Orientated),
+Albers, Mercator A/B, TM (+South Orientated), Polar Stereographic
+A/B, Oblique Stereographic, LAEA, Cassini-Soldner, and Hotine
+Oblique Mercator A/B.  Reference counterpart: proj4j-backed
 MosaicGeometry.transformCRSXY (MosaicGeometry.scala:136-160) and
 OSR-backed RasterProject (RasterProject.scala:45).
 
@@ -73,6 +74,20 @@ class TestLandmarks:
                               code, 4326)
             assert np.abs(rt - pt).max() < 1e-9, code
 
+    def test_tail_methods(self):
+        # Cassini (Berlin Soldner), HOM-B (Malaysia RSO): round-trip +
+        # plausibility of known city coordinates
+        kl = transform_xy(np.array([[101.69, 3.14]]), 4326, 3375)[0]
+        assert kl[0] == pytest.approx(410_400, abs=2000)
+        assert kl[1] == pytest.approx(347_500, abs=2000)
+        b = transform_xy(np.array([[13.4, 52.52]]), 4326, 3068)[0]
+        assert b[0] == pytest.approx(24_700, abs=2000)
+        assert b[1] == pytest.approx(21_500, abs=2000)
+        for code, pt in ((3375, [101.7, 3.1]), (3068, [13.4, 52.5])):
+            rt = transform_xy(transform_xy(np.array([pt]), 4326, code),
+                              code, 4326)
+            assert np.abs(rt - pt).max() < 5e-7, code
+
     def test_roundtrips(self):
         pts = np.array([[-74.05, 40.60], [-73.80, 40.90]])
         for code in (2263, 2154, 5070, 28992, 3035, 3395):
@@ -98,7 +113,7 @@ class TestTableSweep:
         for c in codes:
             p = _proj_entry(int(c))
             lat0 = p["sp1"] if p["method"] == 9829 else p["lat0"]
-            polar = p["method"] in (9810, 9829)
+            polar = p["method"] in (9810, 9829, 9812)
             if polar and abs(lat0) == 90:
                 lat0 = 89.0 * np.sign(lat0)
             x, y = _generic_forward(np.array([p["lon0"]]),

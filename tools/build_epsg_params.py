@@ -38,6 +38,10 @@ SUPPORTED = {
     9829,   # Polar Stereographic (variant B)
     9809,   # Oblique Stereographic
     9820,   # Lambert Azimuthal Equal Area
+    9806,   # Cassini-Soldner
+    9812,   # Hotine Oblique Mercator (variant A)
+    9815,   # Hotine Oblique Mercator (variant B)
+    9826,   # Lambert Conic Conformal (West Orientated)
 }
 
 # parameter slot layout in the packed table (NaN = absent)
@@ -51,6 +55,11 @@ PARAM_SLOT = {
     8806: 5, 8826: 5,          # false easting
     8807: 6, 8827: 6,          # false northing
     8833: 1,                   # ps-B longitude of origin
+    8811: 0, 8812: 1,          # HOM projection-centre lat/lon
+    8813: 2,                   # HOM azimuth at centre
+    8814: 3,                   # HOM rectified-to-skew grid angle
+    8815: 4,                   # HOM scale factor on the initial line
+    8816: 5, 8817: 6,          # HOM variant-B centre easting/northing
 }
 
 
@@ -172,6 +181,8 @@ def main():
             continue
         if method == 9808:
             ok = orients <= {"south", "west"}     # TM-SO's own axes
+        elif method == 9826:
+            ok = orients <= {"west", "north"}     # LCC-W westing axis
         elif method in (9810, 9829):
             # polar axes read "North along 90°E" etc — that IS the
             # standard polar (E,N) frame the 9810/9829 formulas use
