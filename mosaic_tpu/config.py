@@ -22,6 +22,11 @@ MOSAIC_RASTER_CHECKPOINT = "mosaic.raster.checkpoint"
 MOSAIC_RASTER_USE_CHECKPOINT = "mosaic.raster.use.checkpoint"
 MOSAIC_RASTER_TMP_PREFIX = "mosaic.raster.tmp.prefix"
 MOSAIC_RASTER_BLOCKSIZE = "mosaic.raster.blocksize"
+# Observability + CRS-strictness keys (no reference counterpart — the
+# reference leans on the Spark UI; see mosaic_tpu/obs/).
+MOSAIC_TRACE_ENABLED = "mosaic.trace.enabled"
+MOSAIC_METRICS_ENABLED = "mosaic.metrics.enabled"
+MOSAIC_CRS_STRICT_DATUM = "mosaic.crs.strict.datum"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -49,10 +54,22 @@ class MosaicConfig:
     # (design note: DESIGN.md §precision).
     device_dtype: str = "float32"
     exact_fallback: bool = True
+    # Observability switches (see mosaic_tpu/obs/): span tracer and
+    # metrics registry.  Env vars MOSAIC_TPU_TRACE / MOSAIC_TPU_METRICS
+    # override these to on; conf keys only ever turn instruments on.
+    trace_enabled: bool = False
+    metrics_enabled: bool = False
+    # Raise (instead of warn) when a CRS transform would silently apply
+    # an identity datum shift because the EPSG registry carries no
+    # Helmert parameters for the code (helmert_acc is NaN).
+    crs_strict_datum: bool = False
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
         """Build from a reference-style string conf map."""
+        def _flag(key):
+            return str(confs.get(key, "false")).lower() == "true"
+
         return MosaicConfig(
             index_system=confs.get(MOSAIC_INDEX_SYSTEM, "H3"),
             geometry_api=confs.get(MOSAIC_GEOMETRY_API, "JAX"),
@@ -66,6 +83,9 @@ class MosaicConfig:
             raster_blocksize=int(
                 confs.get(MOSAIC_RASTER_BLOCKSIZE,
                           MOSAIC_RASTER_BLOCKSIZE_DEFAULT)),
+            trace_enabled=_flag(MOSAIC_TRACE_ENABLED),
+            metrics_enabled=_flag(MOSAIC_METRICS_ENABLED),
+            crs_strict_datum=_flag(MOSAIC_CRS_STRICT_DATUM),
         )
 
 
@@ -75,6 +95,11 @@ _default_config: MosaicConfig = MosaicConfig()
 def set_default_config(cfg: MosaicConfig) -> None:
     global _default_config
     _default_config = cfg
+    # Conf-driven observability enablement (one-way: never disables an
+    # instrument the env or an explicit enable() already turned on).
+    if cfg.trace_enabled or cfg.metrics_enabled:
+        from .obs import configure
+        configure(cfg)
 
 
 def default_config() -> MosaicConfig:

@@ -618,7 +618,7 @@ def _dissolve_reject(reason: str) -> None:
     global LAST_DISSOLVE_REJECT
     LAST_DISSOLVE_REJECT = reason
     try:
-        from ...utils.trace import tracer
+        from ...obs import tracer
         tracer.count(f"dissolve_reject/{reason}")
     except Exception:
         pass
@@ -648,13 +648,24 @@ def dissolve_disjoint_rings(parts: Sequence[Sequence[np.ndarray]],
     construction.
 
     CONTRACT: pairwise-disjoint interiors is the CALLER's guarantee.
-    The self-checks catch every *accidental* violation seen in practice
-    (edge-split mismatch, duplicated parts, unpartitioned overlap large
-    enough to move the area identity) and fall back, but adversarial
-    overlapping inputs with collinear shared boundaries can in
-    principle slip the area identity — which is why the general
-    ``unary_union_rings`` only takes this path when its caller passes
-    ``assume_disjoint=True``.
+    The self-checks catch the *accidental* violations that move the
+    area identity (duplicated parts, unpartitioned overlap, open
+    walks), but the identity is a necessary condition, not a
+    sufficient one.  Known gap: when two parts share a border but
+    SPLIT it differently (vertices on one side that the other lacks),
+    the opposite-direction wall edges are not bit-identical after
+    snapping, so they fail to cancel — yet the leftover edge pairs
+    stitch into degenerate interior rings whose net signed area is ~0,
+    which passes the area check within tolerance.  The result then
+    carries spurious zero-area interior rings along the shared border
+    WITHOUT triggering the fallback (see PARITY.md "Boolean-engine
+    snap floor").  Tessellation chips of one grid split shared walls
+    identically, so the flagship paths never hit this; callers feeding
+    independently-generated borders must tolerate (or post-filter)
+    such rings.  Adversarial overlapping inputs with collinear shared
+    boundaries can likewise slip the identity — which is why the
+    general ``unary_union_rings`` only takes this path when its caller
+    passes ``assume_disjoint=True``.
     """
     global LAST_DISSOLVE_REJECT
     LAST_DISSOLVE_REJECT = None

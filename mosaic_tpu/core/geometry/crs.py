@@ -682,6 +682,46 @@ def _generic_inverse(x, y, p):
     raise ValueError(f"unimplemented projection method {m}")
 
 
+_DATUM_WARNED = set()
+
+
+def _check_datum_registry(p, epsg: int) -> None:
+    """Surface registry-less datum shifts instead of silently applying
+    the identity.
+
+    605 of the 5,053 table codes carry no Helmert parameters
+    (``helmert_acc`` is NaN, helmert all zeros): for those the datum
+    leg of the transform silently degrades to the identity, which can
+    be off by up to hundreds of meters.  Count every occurrence in the
+    metrics registry, warn once per EPSG code, and raise when the
+    ``mosaic.crs.strict.datum`` conf flag is set.  Codes whose
+    helmert_acc is 0.0 are genuinely WGS84-equivalent and pass
+    silently."""
+    import math
+    acc = p.get("helmert_acc", 0.0)
+    if not (isinstance(acc, float) and math.isnan(acc)):
+        return
+    from ...obs import metrics
+    metrics.count("crs/identity_datum_shift")
+    metrics.count(f"crs/identity_datum_shift/{epsg}")
+    from ...config import default_config
+    if default_config().crs_strict_datum:
+        raise ValueError(
+            f"EPSG {epsg}: the registry has no Helmert datum "
+            "parameters for this code (helmert_acc is NaN) — the "
+            "datum shift would silently be the identity (potentially "
+            "hundreds of meters off).  Unset mosaic.crs.strict.datum "
+            "to accept the approximation.")
+    if epsg not in _DATUM_WARNED:
+        _DATUM_WARNED.add(epsg)
+        import warnings
+        warnings.warn(
+            f"EPSG {epsg}: no Helmert datum parameters in the "
+            "registry — applying an identity datum shift (set "
+            "mosaic.crs.strict.datum=true to raise instead)",
+            RuntimeWarning, stacklevel=3)
+
+
 def _datum_to_wgs84(lon, lat, p):
     lon = lon + p["pm"]                      # CRS PM -> Greenwich
     h = p["helmert"]
@@ -759,6 +799,7 @@ def _to_4326(xy: np.ndarray, epsg: int) -> np.ndarray:
                 f"unsupported source EPSG {epsg} (analytic: 4326, "
                 "3857, 27700, UTM 326xx/327xx; table-driven: 5,053 "
                 "projected codes in epsg_params.npz)")
+        _check_datum_registry(p, epsg)
         lon, lat = _generic_inverse(x, y, p)
         lon, lat = _datum_to_wgs84(lon, lat, p)
     return np.stack([lon, lat], -1)
@@ -782,6 +823,7 @@ def _from_4326(ll: np.ndarray, epsg: int) -> np.ndarray:
                 f"unsupported target EPSG {epsg} (analytic: 4326, "
                 "3857, 27700, UTM 326xx/327xx; table-driven: 5,053 "
                 "projected codes in epsg_params.npz)")
+        _check_datum_registry(p, epsg)
         lon2, lat2 = _wgs84_to_datum(lon, lat, p)
         x, y = _generic_forward(lon2, lat2, p)
     return np.stack([x, y], -1)

@@ -58,6 +58,10 @@ class MosaicContext(RasterFunctions):
         ctx = cls(index_system, geometry_api)
         cls._instance = ctx
         set_default_config(ctx.config)
+        # compile/recompile accounting rides along with every context
+        # (idempotent; one attribute check per event while disabled)
+        from ..obs import install_jax_listeners
+        install_jax_listeners()
         return ctx
 
     # reference: MosaicContext.context() (functions/MosaicContext.scala:1122)
@@ -78,7 +82,7 @@ class MosaicContext(RasterFunctions):
         registration path, sql/extensions/MosaicSQL.scala, where every
         function is reachable by name)."""
         from .registry import REGISTRY
-        from ..utils.trace import tracer
+        from ..obs import tracer
         if name not in REGISTRY:
             raise ValueError(f"unknown function {name!r} (see "
                              "function_names())")

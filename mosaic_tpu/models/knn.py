@@ -725,6 +725,12 @@ class SpatialKNN(IterativeTransformer):
             neighbour)."""
             starts = np.asarray(arr.vertex_starts())
             empty = starts[:-1] >= starts[1:]
+            if len(arr.coords) == 0:
+                # every row empty: no vertex to anchor on (the fancy
+                # index below would fault on the empty coord array);
+                # all-inf reps keep the all -1 / NaN output contract,
+                # mirroring the ring path's empty guard
+                return np.full((len(starts) - 1, 2), np.inf)
             safe = np.minimum(starts[:-1],
                               max(len(arr.coords) - 1, 0))
             v = np.asarray(arr.coords, np.float64)[safe, :2].copy()

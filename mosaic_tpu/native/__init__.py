@@ -22,6 +22,28 @@ _LIB = None
 _TRIED = False
 
 
+#: stale artifacts younger than this survive the sweep: a concurrently
+#: starting checkout with a different source hash may be mid-CDLL on
+#: its own .so, and unlinking it under the loader races the startup
+_SWEEP_MAX_AGE_S = 86_400.0
+
+
+def _compile(src: str, lib_path: str) -> bool:
+    tmp = lib_path + f".build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
 def _build_and_load() -> Optional[ctypes.CDLL]:
     src = os.path.join(os.path.dirname(__file__), "geokernels.cpp")
     cache = os.path.join(tempfile.gettempdir(), "mosaic_tpu_native")
@@ -34,27 +56,41 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         tag = hashlib.sha256(f.read()).hexdigest()[:16]
     lib_path = os.path.join(cache, f"geokernels-{tag}.so")
     if not os.path.exists(lib_path):
-        # drop artifacts of other source revisions (incl. the legacy
-        # un-hashed name) so the shared tmp dir stays bounded
+        # age-gated sweep of other source revisions (incl. the legacy
+        # un-hashed name) so the shared tmp dir stays bounded; fresh
+        # artifacts are spared — a checkout starting in parallel may be
+        # about to CDLL its own .so, and deleting it mid-startup races
+        # that load (the cross-checkout startup race)
+        import time
+        now = time.time()
         for stale in os.listdir(cache):
-            if stale.startswith("geokernels") and \
-                    stale != os.path.basename(lib_path):
-                try:
-                    os.unlink(os.path.join(cache, stale))
-                except OSError:
-                    pass
-        tmp = lib_path + ".build"
-        try:
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
-                check=True, capture_output=True, timeout=120)
-            os.replace(tmp, lib_path)
-        except (OSError, subprocess.SubprocessError):
+            if not stale.startswith("geokernels") or \
+                    stale == os.path.basename(lib_path):
+                continue
+            path = os.path.join(cache, stale)
+            try:
+                if now - os.path.getmtime(path) > _SWEEP_MAX_AGE_S:
+                    os.unlink(path)
+            except OSError:
+                pass
+        if not _compile(src, lib_path):
             return None
     try:
         lib = ctypes.CDLL(lib_path)
     except OSError:
-        return None
+        # our .so existed but would not load (e.g. another checkout's
+        # sweep unlinked it after our existence check, or a truncated
+        # build survived): rebuild once before giving up
+        try:
+            os.unlink(lib_path)
+        except OSError:
+            pass
+        if not _compile(src, lib_path):
+            return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            return None
     lib.pip_first_match.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]

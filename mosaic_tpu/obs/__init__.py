@@ -1,0 +1,50 @@
+"""Observability subsystem: metrics, tracing, JAX telemetry, export.
+
+Grown out of ``mosaic_tpu.utils.trace`` (which remains as a compat
+shim).  Four parts:
+
+* ``obs.metrics`` — process-global registry of counters, gauges, and
+  exponential-bucket histograms (p50/p95/p99 derivable).
+* ``obs.tracer`` — span timer feeding per-stage histograms and a
+  Chrome-trace event ring; plus the GDALCalc-style raster provenance
+  helpers and ``device_trace``.
+* ``obs.jaxmon`` — ``jax.monitoring`` listeners (compile/recompile
+  accounting, recompile-storm flagging) and per-device memory
+  watermarks from ``Device.memory_stats()``.
+* ``obs.chrometrace`` — Perfetto-loadable JSON export of host spans.
+
+Everything is disabled by default and costs one attribute check per
+instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
+``MOSAIC_TPU_METRICS=1``, the ``mosaic.trace.enabled`` /
+``mosaic.metrics.enabled`` conf keys, or ``tracer.enable()`` /
+``metrics.enable()``.
+"""
+
+from __future__ import annotations
+
+from .chrometrace import chrome_trace_events, export_chrome_trace
+from .jaxmon import STORM_THRESHOLD, install_jax_listeners, sample_memory
+from .metrics import Histogram, MetricsRegistry, metrics
+from .tracer import (Tracer, device_trace, record_command, record_error,
+                     tracer)
+
+__all__ = [
+    "Histogram", "MetricsRegistry", "metrics",
+    "Tracer", "tracer", "record_command", "record_error", "device_trace",
+    "install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
+    "chrome_trace_events", "export_chrome_trace",
+    "configure",
+]
+
+
+def configure(config) -> None:
+    """Apply a ``MosaicConfig``'s observability switches (idempotent).
+
+    ``trace_enabled`` turns the tracer (and with it the registry) on;
+    ``metrics_enabled`` turns just the registry on.  Neither flag ever
+    turns an already-enabled instrument off — env vars and explicit
+    ``enable()`` calls win."""
+    if getattr(config, "trace_enabled", False):
+        tracer.enable()
+    if getattr(config, "metrics_enabled", False):
+        metrics.enable()

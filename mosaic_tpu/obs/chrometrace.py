@@ -1,0 +1,51 @@
+"""Chrome-trace (Perfetto) JSON export of host spans.
+
+The tracer keeps a bounded ring of completed spans; this module renders
+them in the Trace Event Format (``ph: "X"`` complete events, timestamps
+in microseconds) that chrome://tracing and https://ui.perfetto.dev load
+directly.  Typical use: capture a device timeline with
+``obs.device_trace`` while the host tracer runs, then lay this export
+beside the xprof capture to line host stages up with device activity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+from .tracer import tracer
+
+__all__ = ["chrome_trace_events", "export_chrome_trace"]
+
+
+def chrome_trace_events() -> Dict[str, object]:
+    """Build the Trace Event Format document from the tracer's ring."""
+    pid = os.getpid()
+    events = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "mosaic_tpu host"},
+    }]
+    for qual, start_s, dur_s, tid in tracer.events():
+        events.append({
+            "name": qual,
+            "cat": "host",
+            "ph": "X",
+            "ts": start_s * 1e6,
+            "dur": dur_s * 1e6,
+            "pid": pid,
+            "tid": tid,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the host-span timeline to ``path`` as Perfetto-loadable
+    JSON; returns ``path``."""
+    doc = chrome_trace_events()
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path

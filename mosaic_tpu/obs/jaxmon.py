@@ -1,0 +1,129 @@
+"""JAX runtime telemetry: JIT compile accounting + device memory peaks.
+
+Two feeds, both recorded into ``obs.metrics``:
+
+* **Compile events** via ``jax.monitoring`` listeners.  XLA's monitoring
+  events are anonymous (no function names), so each backend compile is
+  attributed to the tracer's innermost active host span at the moment it
+  fires — e.g. a recompile triggered inside ``mc.call("st_area", ...)``
+  lands on ``jax/recompiles/call/st_area``.  A per-label count crossing
+  ``STORM_THRESHOLD`` flags a **recompile storm** (the classic ragged
+  geometry-batch failure mode: every batch a new shape, every shape a
+  new compile) with a one-shot warning plus a ``jax/recompile_storms``
+  counter.
+* **Memory watermarks** via ``Device.memory_stats()``.  TPU/GPU backends
+  report allocator stats (``peak_bytes_in_use``); CPU backends return
+  ``None``, in which case the host's peak RSS stands in so the gauge
+  still exists on CPU runs (named ``mem/peak_bytes/<device>``, source
+  recorded in ``mem/source/<device>``... see ``sample_memory``).
+
+Listeners are process-global and idempotent to install; they cost one
+attribute check per event while the registry is disabled.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Dict, Optional
+
+from .metrics import metrics
+from .tracer import tracer
+
+__all__ = ["install_jax_listeners", "sample_memory", "STORM_THRESHOLD"]
+
+# a label re-compiling this many times is a storm (ragged batches)
+STORM_THRESHOLD = 8
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_TRACE_DUR = "/jax/core/compile/jaxpr_trace_duration"
+_LOWER_DUR = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+
+_install_lock = threading.Lock()
+_installed = False
+_storms_flagged = set()
+
+
+def _on_duration(name: str, dur: float, **kw) -> None:
+    if not metrics.enabled:
+        return
+    if name == _BACKEND_COMPILE:
+        label = tracer.current_label() or "<toplevel>"
+        metrics.count("jax/recompiles")
+        metrics.count(f"jax/recompiles/{label}")
+        metrics.observe("jax/compile_s", dur)
+        n = metrics.counter_value(f"jax/recompiles/{label}")
+        if n >= STORM_THRESHOLD and label not in _storms_flagged:
+            _storms_flagged.add(label)
+            metrics.count("jax/recompile_storms")
+            warnings.warn(
+                f"recompile storm: {int(n)} XLA compiles attributed to "
+                f"span {label!r} — likely ragged batch shapes; pad or "
+                f"bucket inputs to stabilise shapes", RuntimeWarning,
+                stacklevel=2)
+    elif name == _TRACE_DUR:
+        metrics.observe("jax/trace_s", dur)
+    elif name == _LOWER_DUR:
+        metrics.observe("jax/lower_s", dur)
+
+
+def _on_event(name: str, **kw) -> None:
+    if not metrics.enabled:
+        return
+    if name.startswith("/jax/compilation_cache/"):
+        metrics.count(f"jax/cache/{name.rsplit('/', 1)[1]}")
+
+
+def install_jax_listeners() -> bool:
+    """Register the monitoring listeners once per process.  Returns True
+    if this call performed the installation."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return False
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _installed = True
+        return True
+
+
+def sample_memory(devices=None) -> Dict[str, Dict[str, Optional[float]]]:
+    """Sample per-device memory watermarks into gauges.
+
+    For each device, records ``mem/peak_bytes/<platform>:<id>`` (max-
+    tracked, so repeated samples keep the high-water mark) and returns
+    the raw stats.  Devices without allocator stats (CPU) fall back to
+    the process peak RSS; the ``source`` field says which one you got.
+    """
+    import jax
+    out: Dict[str, Dict[str, Optional[float]]] = {}
+    host_peak = _host_peak_rss_bytes()
+    for d in (devices if devices is not None else jax.devices()):
+        key = f"{d.platform}:{d.id}"
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if st:
+            peak = float(st.get("peak_bytes_in_use",
+                                st.get("bytes_in_use", 0.0)))
+            out[key] = {"peak_bytes": peak,
+                        "bytes_in_use": float(st.get("bytes_in_use", 0.0)),
+                        "source": "allocator"}
+        else:
+            peak = float(host_peak)
+            out[key] = {"peak_bytes": peak, "bytes_in_use": None,
+                        "source": "host_rss"}
+        metrics.gauge_max(f"mem/peak_bytes/{key}", peak)
+    if host_peak:
+        metrics.gauge_max("mem/host_peak_rss_bytes", float(host_peak))
+    return out
+
+
+def _host_peak_rss_bytes() -> int:
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0

@@ -1,0 +1,211 @@
+"""Span tracer: per-stage histograms, counters, Chrome-trace events.
+
+Reference counterpart: Mosaic has no custom tracer — it leans on the
+Spark UI for task timing and records ``last_command``/``last_error``/
+``full_error`` into raster tile metadata for post-hoc debugging
+(core/raster/operator/gdal/GDALCalc.scala:39-55); micro-benchmarks use
+``SparkSuite.benchmark`` (test/SparkSuite.scala:30-36).  Standalone, we
+supply the equivalent surface ourselves:
+
+* ``tracer`` — process-global span timer.  Each span aggregates
+  total/calls/max (the original flat counters) **and** an
+  exponential-bucket histogram so ``report()`` carries p50/p95/p99 per
+  stage.  Spans also append to a bounded event ring that
+  ``obs.chrometrace.export_chrome_trace`` turns into a Perfetto-loadable
+  JSON timeline.  Disabled by default; enable with ``tracer.enable()``
+  or ``MOSAIC_TPU_TRACE=1``.  ``MosaicContext.call`` wraps every by-name
+  dispatch in a span, so external engines driving the string surface get
+  per-function wall times for free.
+* ``record_command`` / ``record_error`` — the GDALCalc metadata pattern:
+  raster operators stamp what ran (and what failed) into ``tile.meta``;
+  both also bump registry counters so fleet-wide rates are visible.
+* ``device_trace`` — context manager around ``jax.profiler.trace`` for
+  XLA/TPU timeline captures (inspect with tensorboard or xprof; lay the
+  Chrome-trace export of host spans beside it to line host stages up
+  with device activity).
+
+``tracer.enable()`` also enables the metrics registry (span call-sites
+feed counters/gauges into it); ``disable()`` turns the registry back off
+unless ``MOSAIC_TPU_METRICS`` asked for it independently.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import Histogram, metrics
+
+__all__ = ["Tracer", "tracer", "record_command", "record_error",
+           "device_trace"]
+
+_MAX_EVENTS = 100_000   # bounded Chrome-trace ring (~10 MB of JSON)
+
+
+class _Span:
+    __slots__ = ("name", "total_s", "calls", "max_s", "hist")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total_s = 0.0
+        self.calls = 0
+        self.max_s = 0.0
+        self.hist = Histogram(name)
+
+
+class Tracer:
+    """Span wall-times + named counters, thread-safe, ~zero cost when
+    disabled (one attribute check per span)."""
+
+    def __init__(self):
+        self._enabled = bool(os.environ.get("MOSAIC_TPU_TRACE"))
+        self._lock = threading.Lock()
+        self._spans: Dict[str, _Span] = {}
+        self._counters: Dict[str, float] = {}
+        self._stack = threading.local()
+        self._events: "collections.deque[Tuple[str, float, float, int]]" \
+            = collections.deque(maxlen=_MAX_EVENTS)
+        self._epoch = time.perf_counter()
+
+    # -- switches
+    def enable(self) -> None:
+        self._enabled = True
+        metrics.enable()
+
+    def disable(self) -> None:
+        self._enabled = False
+        if not os.environ.get("MOSAIC_TPU_METRICS"):
+            metrics.disable()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._counters.clear()
+            self._events.clear()
+            self._epoch = time.perf_counter()
+        metrics.reset()
+
+    # -- spans
+    @contextlib.contextmanager
+    def span(self, name: str):
+        if not self._enabled:
+            yield
+            return
+        stack: List[str] = getattr(self._stack, "names", None) or []
+        self._stack.names = stack
+        stack.append(name)
+        qual = "/".join(stack)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            dt = t1 - t0
+            stack.pop()
+            with self._lock:
+                s = self._spans.setdefault(qual, _Span(qual))
+                s.total_s += dt
+                s.calls += 1
+                s.max_s = max(s.max_s, dt)
+                s.hist.observe(dt)
+                self._events.append(
+                    (qual, t0 - self._epoch, dt, threading.get_ident()))
+
+    def current_label(self) -> Optional[str]:
+        """Innermost active span on this thread (None outside spans).
+        Used by ``obs.jaxmon`` to attribute anonymous JAX compile events
+        to whatever stage triggered them."""
+        stack = getattr(self._stack, "names", None)
+        return "/".join(stack) if stack else None
+
+    # -- counters
+    def count(self, name: str, value: float = 1.0) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    # -- Chrome-trace events
+    def events(self) -> List[Tuple[str, float, float, int]]:
+        """Snapshot of (qualified name, start offset s, duration s,
+        thread id) complete-span events, oldest first."""
+        with self._lock:
+            return list(self._events)
+
+    # -- reporting
+    def report(self) -> Dict[str, object]:
+        """One-stop snapshot: per-stage span histograms plus everything
+        the metrics registry holds (counters merged; tracer-local names
+        win on collision)."""
+        reg = metrics.report()
+        with self._lock:
+            spans = {}
+            for n, s in self._spans.items():
+                h = s.hist.snapshot()
+                spans[n] = {"total_s": s.total_s, "calls": s.calls,
+                            "max_s": s.max_s, "p50_s": h["p50"],
+                            "p95_s": h["p95"], "p99_s": h["p99"]}
+            counters = dict(reg["counters"])
+            counters.update(self._counters)
+            return {
+                "spans": spans,
+                "counters": counters,
+                "gauges": reg["gauges"],
+                "histograms": reg["histograms"],
+            }
+
+    def format_report(self) -> str:
+        rep = self.report()
+        lines = [f"{'span':<44} {'calls':>6} {'total_s':>9} "
+                 f"{'p50_s':>8} {'p95_s':>8} {'max_s':>8}"]
+        for n, s in sorted(rep["spans"].items(),
+                           key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{n:<44} {s['calls']:>6} "
+                         f"{s['total_s']:>9.4f} {s['p50_s']:>8.4f} "
+                         f"{s['p95_s']:>8.4f} {s['max_s']:>8.4f}")
+        for n, v in sorted(rep["counters"].items()):
+            lines.append(f"counter {n} = {v:g}")
+        for n, v in sorted(rep["gauges"].items()):
+            lines.append(f"gauge {n} = {v:g}")
+        for n, h in sorted(rep["histograms"].items()):
+            lines.append(f"hist {n}: count={h['count']} "
+                         f"p50={h['p50']:g} p95={h['p95']:g} "
+                         f"p99={h['p99']:g}")
+        return "\n".join(lines)
+
+
+tracer = Tracer()
+
+
+# -- raster-op provenance (reference: GDALCalc.scala:39-55 records
+#    last_command / last_error / full_error into tile metadata)
+
+def record_command(tile, command: str) -> None:
+    tile.meta["last_command"] = command
+    metrics.count("raster/commands")
+
+
+def record_error(tile, err: BaseException) -> None:
+    tile.meta["last_error"] = f"{type(err).__name__}: {err}"[:200]
+    tile.meta["full_error"] = repr(err)
+    metrics.count(f"raster/errors/{type(err).__name__}")
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str, host_tracer_level: int = 2):
+    """Capture an XLA/TPU profiler timeline into ``logdir`` (reference
+    analogue: the Spark UI stage timeline).  View with xprof/tensorboard."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

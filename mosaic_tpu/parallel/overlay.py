@@ -126,6 +126,35 @@ def _exact_bucket_cap(cells: np.ndarray, valid: np.ndarray,
     return max(64, int(np.bincount(d, minlength=n_dev).max()))
 
 
+def _account_exchange(site: str, D: int, bucket_cap: int, cap_e: int,
+                      id_bytes: int, cells: np.ndarray,
+                      valid: np.ndarray) -> None:
+    """Host-side collective accounting for one `_exchange_rows` run.
+
+    Bytes come from the static send-buffer shapes each device pushes
+    through the four all_to_alls (per row: cell i64 + id column +
+    [cap_e, 4] f32 edges + valid bool; D*bucket_cap rows per device, D
+    devices); shard skew is max/mean of the exact host-side hash
+    destination counts (`_hash_dest_np` mirrors the device hash).  One
+    attribute check when metrics are disabled."""
+    from ..obs import metrics
+    if not metrics.enabled:
+        return
+    row_bytes = 8 + id_bytes + cap_e * 16 + 1
+    moved = float(D) * D * bucket_cap * row_bytes
+    metrics.count("collective/all_to_all_bytes", moved)
+    metrics.count(f"collective/all_to_all_bytes/{site}", moved)
+    metrics.count("collective/all_to_all_calls", 4)
+    v = np.asarray(valid, bool)
+    if v.any():
+        counts = np.bincount(_hash_dest_np(np.asarray(cells)[v], D),
+                             minlength=D)
+        mean = float(counts.mean())
+        metrics.gauge(f"shard/skew/{site}",
+                      float(counts.max()) / mean if mean else 1.0)
+        metrics.gauge(f"shard/rows_max/{site}", float(counts.max()))
+
+
 def _exact_dup_cap(cells_a: np.ndarray, valid_a: np.ndarray,
                    cells_b: np.ndarray, valid_b: np.ndarray) -> int:
     """Exact probe width: the max chip multiplicity among A cells that
@@ -276,7 +305,10 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
         return jax.jit(fn)
 
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:      # moved in newer jax; older keeps it here
+        from jax.experimental.shard_map import shard_map
     D = mesh.shape[axis]
     assert bucket_cap > 0, "sharded overlay needs a bucket capacity"
 
@@ -392,7 +424,10 @@ def make_overlay_pairs_fn(row_mult: int, edge_cap_a: int,
         return jax.jit(fn)
 
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:      # moved in newer jax; older keeps it here
+        from jax.experimental.shard_map import shard_map
     D = mesh.shape[axis]
     assert bucket_cap > 0
 
@@ -508,6 +543,10 @@ def overlay_row_pairs(chips_a, chips_b, polys_a: GeometryArray,
                 row_mult, ea.shape[1], eb.shape[1], mesh=mesh,
                 axis=axis, bucket_cap=bucket_cap, dup_cap=dup_cap,
                 pair_cap=pair_cap, eps=eps)
+            _account_exchange("overlay_pairs", D, bucket_cap,
+                              ea.shape[1], 8, ca, va)
+            _account_exchange("overlay_pairs", D, bucket_cap,
+                              eb.shape[1], 8, cb, vb)
         keys, counts, diag = fn(*args)
         diag = np.asarray(diag)
         if mesh is not None and (diag[0] > 0 or diag[1] > 0):
@@ -653,6 +692,10 @@ def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
                                  mesh=mesh, axis=axis,
                                  bucket_cap=bucket_cap, dup_cap=dup_cap,
                                  eps=eps)
+            _account_exchange("overlay", D, bucket_cap, ea.shape[1], 4,
+                              ca, va)
+            _account_exchange("overlay", D, bucket_cap, eb.shape[1], 4,
+                              cb, vb)
         h, z, diag = fn(*args)
         diag = np.asarray(diag)
         if mesh is not None and (diag[0] > 0 or diag[1] > 0):

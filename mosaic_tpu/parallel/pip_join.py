@@ -303,15 +303,51 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
 
     Returns a jitted fn points[N,2] -> (zone [N], uncertain [N]) with N
     divisible by the mesh axis size.  Collectives only appear in
-    aggregations layered on top (see zone_histogram)."""
+    aggregations layered on top (see zone_histogram).
+
+    Observability: with the metrics registry enabled, the wrapper
+    records the replicated-index footprint (the broadcast-join's data
+    movement: every device holds the whole index) and, on the first
+    call only, the per-shard matched-candidate skew (max/mean of
+    zone >= 0 counts per shard — reading it back every call would put a
+    host sync on the hot path)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..obs import metrics
 
     fn = make_pip_join_fn(idx, grid, eps, margin_eps)
     pts_sharding = NamedSharding(mesh, P(axis, None))
     out_sharding = (NamedSharding(mesh, P(axis)),
                     NamedSharding(mesh, P(axis)))
-    return jax.jit(fn, in_shardings=(pts_sharding,),
-                   out_shardings=out_sharding)
+    jfn = jax.jit(fn, in_shardings=(pts_sharding,),
+                  out_shardings=out_sharding)
+    D = mesh.shape[axis]
+    idx_bytes = sum(int(np.asarray(leaf).nbytes)
+                    for leaf in jax.tree_util.tree_leaves(idx))
+    state = {"first": True}
+
+    def wrapped(points):
+        out = jfn(points)
+        if metrics.enabled:
+            metrics.gauge("collective/replicated_index_bytes",
+                          float(idx_bytes) * D)
+            n = int(points.shape[0])
+            metrics.count("collective/points_scatter_bytes",
+                          float(points.size) * points.dtype.itemsize)
+            metrics.gauge("shard/points_per_shard/pip_join", n / D)
+            if state["first"]:
+                state["first"] = False
+                metrics.count("collective/broadcast_bytes",
+                              float(idx_bytes) * max(D - 1, 1))
+                zones = np.asarray(out[0]).reshape(D, -1)
+                c = (zones >= 0).sum(axis=1)
+                mean = float(c.mean())
+                metrics.gauge("shard/skew/pip_join",
+                              float(c.max()) / mean if mean else 1.0)
+                metrics.gauge("shard/candidates_max/pip_join",
+                              float(c.max()))
+        return out
+
+    return wrapped
 
 
 def zone_histogram(zone: jnp.ndarray, num_zones: int) -> jnp.ndarray:
@@ -421,7 +457,7 @@ def _dense_reject(reason: str) -> None:
     global LAST_DENSE_REJECT
     LAST_DENSE_REJECT = reason
     try:
-        from ..utils.trace import tracer
+        from ..obs import tracer
         tracer.count(f"dense_reject/{reason}")
     except Exception:
         pass

@@ -36,7 +36,10 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
     slabs)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:      # moved in newer jax; older keeps it here
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     k = np.asarray(kernel, np.float64)
@@ -89,9 +92,18 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
             in_specs=P(None, axis, None),
             out_specs=P(None, axis, None)))
         _JIT_CACHE[key] = fn
+    from ..obs import metrics, tracer
+    if metrics.enabled:
+        # two ppermute shifts move `halo` rows per device each way:
+        # bands * halo * W f32 per device per shift, D devices
+        moved = 2.0 * D * bands * halo * W * 4
+        metrics.count("collective/ppermute_bytes", moved)
+        metrics.count("collective/ppermute_bytes/raster_halo", moved)
+        metrics.count("collective/ppermute_calls", 2)
     arr = jax.device_put(
         jnp.asarray(data),
         NamedSharding(mesh, P(None, axis, None)))
-    out = np.asarray(fn(arr))
+    with tracer.span("halo/convolve"):
+        out = np.asarray(fn(arr))
     return RasterTile(out, tile.gt, nodata=None, srid=tile.srid,
                       meta={"op": "convolve", "sharded": "halo"})

@@ -25,11 +25,13 @@ reference's users filter tessellations.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..core.geometry.array import GeometryArray
+from ..obs import metrics, tracer
 from .parser import (Binary, Call, Column, Literal, Query, SelectItem,
                      Star, Unary, parse)
 
@@ -180,41 +182,123 @@ class SQLSession:
 
     # -- query entry
     def sql(self, query: str) -> Table:
+        """Run a query.  ``EXPLAIN ANALYZE SELECT ...`` executes the
+        query and returns the per-operator profile instead of the
+        result (operator, detail, rows out, wall ms); bare ``EXPLAIN``
+        returns the plan without executing."""
         q = parse(query)
-        base_env = self._from_clause(q)
-        # explode generators before WHERE so filters see generated cols
-        env, gen_items = self._apply_generators(q, base_env)
-        if q.where is not None:
-            n = self._env_len(env)
-            mask = _as_mask(self._eval(q.where, env), n)
-            env = self._take_env(env, np.flatnonzero(mask))
-        if q.group_by is not None or self._has_aggregate(q.items):
-            out = self._aggregate(q, env, gen_items)
+        if q.explain == "plan":
+            ops = self._plan_ops(q)
+            return Table({"operator": [o for o, _ in ops],
+                          "detail": [d for _, d in ops]})
+        if q.explain == "analyze":
+            prof: List[tuple] = []
+            self._execute(q, prof)
+            return Table({"operator": [p[0] for p in prof],
+                          "detail": [p[1] for p in prof],
+                          "rows": np.asarray([p[2] for p in prof],
+                                             np.int64),
+                          "time_ms": np.asarray([p[3] * 1e3
+                                                 for p in prof])})
+        return self._execute(q, None)
+
+    def _plan_ops(self, q: Query) -> List[tuple]:
+        """Static operator list in execution order (EXPLAIN output)."""
+        ops = []
+        if q.join is not None:
+            ops.append((f"{q.join_kind}_join",
+                        f"{q.table.name} ⋈ {q.join.name}"))
         else:
-            out = self._project(q.items, env, gen_items)
+            ops.append(("scan", q.table.name))
+        gens = [it.expr.name for it in q.items
+                if isinstance(it.expr, Call) and
+                it.expr.name in GENERATORS]
+        if gens:
+            ops.append(("generate", gens[0]))
+        if q.where is not None:
+            ops.append(("filter", "WHERE"))
+        if q.group_by is not None or self._has_aggregate(q.items):
+            ops.append(("aggregate",
+                        f"{len(q.group_by or [])} group keys"))
+        else:
+            ops.append(("project", f"{len(q.items)} items"))
         if q.order_by:
-            grouped = q.group_by is not None or \
-                self._has_aggregate(q.items)
-            keys = []
-            for e, desc in reversed(q.order_by):
-                try:
-                    v = self._eval(e, _Env({"_t": out}))
-                except SQLError:
-                    if grouped:
-                        raise  # pre-aggregation rows no longer exist
-                    # non-projected or qualified column: evaluate
-                    # against the pre-projection env (same row count
-                    # and order as the projected output)
-                    v = self._eval(e, env)
-                k = np.asarray(_numeric(v))
-                if not np.issubdtype(k.dtype, np.number):
-                    # rank-encode so lexsort and DESC negation apply
-                    _, k = np.unique(k, return_inverse=True)
-                keys.append(-k if desc else k)
-            idx = np.lexsort(keys)
-            out = out.take(idx)
+            ops.append(("order", f"{len(q.order_by)} keys"))
         if q.limit is not None:
-            out = out.head(q.limit)
+            ops.append(("limit", str(q.limit)))
+        return ops
+
+    def _execute(self, q: Query, prof: Optional[List[tuple]]) -> Table:
+        def stage(op: str, detail: str, fn, rows_of):
+            with tracer.span(f"sql/{op}"):
+                t0 = time.perf_counter()
+                res = fn()
+                dt = time.perf_counter() - t0
+            if prof is not None:
+                prof.append((op, detail, rows_of(res), dt))
+            if metrics.enabled:
+                metrics.observe(f"sql/{op}_s", dt)
+            return res
+
+        if q.join is not None:
+            base_env = stage(f"{q.join_kind}_join",
+                             f"{q.table.name} ⋈ {q.join.name}",
+                             lambda: self._from_clause(q),
+                             self._env_len)
+        else:
+            base_env = stage("scan", q.table.name,
+                             lambda: self._from_clause(q),
+                             self._env_len)
+        # explode generators before WHERE so filters see generated cols
+        env, gen_items = stage(
+            "generate",
+            next((it.expr.name for it in q.items
+                  if isinstance(it.expr, Call) and
+                  it.expr.name in GENERATORS), "-"),
+            lambda: self._apply_generators(q, base_env),
+            lambda r: self._env_len(r[0]))
+        if not gen_items and prof is not None:
+            prof.pop()            # no generator ran; drop the stub row
+        if q.where is not None:
+            def _filter():
+                n = self._env_len(env)
+                mask = _as_mask(self._eval(q.where, env), n)
+                return self._take_env(env, np.flatnonzero(mask))
+            env = stage("filter", "WHERE", _filter, self._env_len)
+        if q.group_by is not None or self._has_aggregate(q.items):
+            out = stage("aggregate",
+                        f"{len(q.group_by or [])} group keys",
+                        lambda: self._aggregate(q, env, gen_items), len)
+        else:
+            out = stage("project", f"{len(q.items)} items",
+                        lambda: self._project(q.items, env, gen_items),
+                        len)
+        if q.order_by:
+            def _order():
+                grouped = q.group_by is not None or \
+                    self._has_aggregate(q.items)
+                keys = []
+                for e, desc in reversed(q.order_by):
+                    try:
+                        v = self._eval(e, _Env({"_t": out}))
+                    except SQLError:
+                        if grouped:
+                            raise  # pre-aggregation rows no longer exist
+                        # non-projected or qualified column: evaluate
+                        # against the pre-projection env (same row count
+                        # and order as the projected output)
+                        v = self._eval(e, env)
+                    k = np.asarray(_numeric(v))
+                    if not np.issubdtype(k.dtype, np.number):
+                        # rank-encode so lexsort and DESC negation apply
+                        _, k = np.unique(k, return_inverse=True)
+                    keys.append(-k if desc else k)
+                idx = np.lexsort(keys)
+                return out.take(idx)
+            out = stage("order", f"{len(q.order_by)} keys", _order, len)
+        if q.limit is not None:
+            out = stage("limit", str(q.limit),
+                        lambda: out.head(q.limit), len)
         return out
 
     # -- FROM / JOIN

@@ -10,7 +10,8 @@ and limits.
 
 Grammar (case-insensitive keywords)::
 
-    query   := SELECT item (',' item)* FROM ref (JOIN ref ON expr)?
+    query   := (EXPLAIN ANALYZE?)? SELECT item (',' item)* FROM ref
+               (JOIN ref ON expr)?
                (WHERE expr)? (GROUP BY expr (',' expr)*)?
                (ORDER BY expr (ASC|DESC)?)? (LIMIT int)?
     ref     := ident (AS? ident)?
@@ -89,6 +90,7 @@ class Query:
     having: Optional[object] = None
     order_by: Optional[List[Tuple[object, bool]]] = None   # (expr, desc)
     limit: Optional[int] = None
+    explain: Optional[str] = None      # None | 'plan' | 'analyze'
 
 
 # ------------------------------------------------------------- tokens
@@ -105,7 +107,7 @@ _TOKEN_RE = re.compile(r"""
 _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "and", "or", "not", "as", "join", "on", "asc", "desc",
              "true", "false", "null", "is", "inner", "left", "outer",
-             "having"}
+             "having", "explain", "analyze"}
 
 
 def _tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -168,6 +170,10 @@ class _Parser:
 
     # -- grammar
     def query(self) -> Query:
+        explain = None
+        if self.accept("kw", "explain"):
+            explain = "analyze" if self.accept("kw", "analyze") \
+                else "plan"
         self.expect("kw", "select")
         items = [self.select_item()]
         while self.accept("op", ","):
@@ -218,7 +224,7 @@ class _Parser:
             limit = int(self.expect("num"))
         self.expect("eof")
         return Query(items, table, join, join_on, join_kind, where,
-                     group_by, having, order_by, limit)
+                     group_by, having, order_by, limit, explain)
 
     def order_item(self) -> Tuple[object, bool]:
         e = self.expr()

@@ -1,142 +1,16 @@
-"""Tracing / profiling subsystem.
+"""Compat shim: the tracing subsystem moved to :mod:`mosaic_tpu.obs`.
 
-Reference counterpart: Mosaic has no custom tracer — it leans on the
-Spark UI for task timing and records ``last_command``/``last_error``/
-``full_error`` into raster tile metadata for post-hoc debugging
-(core/raster/operator/gdal/GDALCalc.scala:39-55); micro-benchmarks use
-``SparkSuite.benchmark`` (test/SparkSuite.scala:30-36).  Standalone, we
-supply the equivalent surface ourselves:
-
-* ``tracer`` — process-global span timer + counters (the Spark-UI
-  analogue).  Disabled by default; enable with ``tracer.enable()`` or
-  ``MOSAIC_TPU_TRACE=1``.  ``MosaicContext.call`` wraps every by-name
-  dispatch in a span, so external engines driving the string surface get
-  per-function wall times for free.
-* ``record_command`` / ``record_error`` — the GDALCalc metadata pattern:
-  raster operators stamp what ran (and what failed) into ``tile.meta``.
-* ``device_trace`` — context manager around ``jax.profiler.trace`` for
-  XLA/TPU timeline captures (inspect with tensorboard or xprof).
+Everything that used to live here (``tracer``, ``Tracer``,
+``record_command``, ``record_error``, ``device_trace``) re-exports from
+the grown observability package, which adds the metrics registry,
+JAX compile/memory telemetry, and Chrome-trace export.  Import from
+``mosaic_tpu.obs`` in new code.
 """
 
 from __future__ import annotations
 
-import contextlib
-import os
-import threading
-import time
-from typing import Dict, List, Optional
+from ..obs import (Tracer, device_trace, metrics, record_command,
+                   record_error, tracer)
 
-
-class _Span:
-    __slots__ = ("name", "total_s", "calls", "max_s")
-
-    def __init__(self, name: str):
-        self.name = name
-        self.total_s = 0.0
-        self.calls = 0
-        self.max_s = 0.0
-
-
-class Tracer:
-    """Span wall-times + named counters, thread-safe, ~zero cost when
-    disabled (one attribute check per span)."""
-
-    def __init__(self):
-        self._enabled = bool(os.environ.get("MOSAIC_TPU_TRACE"))
-        self._lock = threading.Lock()
-        self._spans: Dict[str, _Span] = {}
-        self._counters: Dict[str, float] = {}
-        self._stack = threading.local()
-
-    # -- switches
-    def enable(self) -> None:
-        self._enabled = True
-
-    def disable(self) -> None:
-        self._enabled = False
-
-    @property
-    def enabled(self) -> bool:
-        return self._enabled
-
-    def reset(self) -> None:
-        with self._lock:
-            self._spans.clear()
-            self._counters.clear()
-
-    # -- spans
-    @contextlib.contextmanager
-    def span(self, name: str):
-        if not self._enabled:
-            yield
-            return
-        stack: List[str] = getattr(self._stack, "names", None) or []
-        self._stack.names = stack
-        stack.append(name)
-        qual = "/".join(stack)
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            stack.pop()
-            with self._lock:
-                s = self._spans.setdefault(qual, _Span(qual))
-                s.total_s += dt
-                s.calls += 1
-                s.max_s = max(s.max_s, dt)
-
-    # -- counters
-    def count(self, name: str, value: float = 1.0) -> None:
-        if not self._enabled:
-            return
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0.0) + value
-
-    # -- reporting
-    def report(self) -> Dict[str, object]:
-        with self._lock:
-            return {
-                "spans": {n: {"total_s": s.total_s, "calls": s.calls,
-                              "max_s": s.max_s}
-                          for n, s in self._spans.items()},
-                "counters": dict(self._counters),
-            }
-
-    def format_report(self) -> str:
-        rep = self.report()
-        lines = [f"{'span':<44} {'calls':>6} {'total_s':>9} {'max_s':>8}"]
-        for n, s in sorted(rep["spans"].items(),
-                           key=lambda kv: -kv[1]["total_s"]):
-            lines.append(f"{n:<44} {s['calls']:>6} "
-                         f"{s['total_s']:>9.4f} {s['max_s']:>8.4f}")
-        for n, v in sorted(rep["counters"].items()):
-            lines.append(f"counter {n} = {v:g}")
-        return "\n".join(lines)
-
-
-tracer = Tracer()
-
-
-# -- raster-op provenance (reference: GDALCalc.scala:39-55 records
-#    last_command / last_error / full_error into tile metadata)
-
-def record_command(tile, command: str) -> None:
-    tile.meta["last_command"] = command
-
-
-def record_error(tile, err: BaseException) -> None:
-    tile.meta["last_error"] = f"{type(err).__name__}: {err}"[:200]
-    tile.meta["full_error"] = repr(err)
-
-
-@contextlib.contextmanager
-def device_trace(logdir: str, host_tracer_level: int = 2):
-    """Capture an XLA/TPU profiler timeline into ``logdir`` (reference
-    analogue: the Spark UI stage timeline).  View with xprof/tensorboard."""
-    import jax
-    jax.profiler.start_trace(logdir)
-    try:
-        yield logdir
-    finally:
-        jax.profiler.stop_trace()
+__all__ = ["Tracer", "tracer", "metrics", "record_command",
+           "record_error", "device_trace"]

@@ -341,3 +341,32 @@ def test_having_edge_cases(session):
     # ungrouped bare column must raise, not take first rows
     with _pytest.raises(SQLError, match="GROUP BY"):
         session.sql("SELECT k FROM h2 GROUP BY k HAVING v > 1.5")
+
+
+def test_explain_and_explain_analyze(session):
+    session.create_table("ea", {
+        "k": np.array([1, 2, 3, 4], np.int64),
+        "v": np.array([1.0, 2.0, 3.0, 4.0])})
+    # EXPLAIN: static operator plan, nothing executed
+    plan = session.sql("EXPLAIN SELECT k FROM ea WHERE v > 1.5")
+    ops = list(plan.columns["operator"])
+    assert ops == ["scan", "filter", "project"]
+    assert "rows" not in plan.columns
+    # EXPLAIN ANALYZE: executed plan with per-operator rows + wall time
+    out = session.sql("EXPLAIN ANALYZE SELECT k, v FROM ea "
+                      "WHERE v > 1.5 ORDER BY v DESC LIMIT 2")
+    ops = list(out.columns["operator"])
+    assert ops == ["scan", "filter", "project", "order", "limit"]
+    rows = dict(zip(ops, out.columns["rows"].tolist()))
+    assert rows["scan"] == 4 and rows["filter"] == 3
+    assert rows["limit"] == 2
+    assert out.columns["rows"].dtype == np.int64
+    times = out.columns["time_ms"]
+    assert len(times) == 5 and all(t >= 0.0 for t in times.tolist())
+    # aggregates show as an aggregate operator with group-key detail
+    agg = session.sql("EXPLAIN ANALYZE SELECT k, count(*) AS n "
+                      "FROM ea GROUP BY k")
+    aops = list(agg.columns["operator"])
+    assert "aggregate" in aops and "project" not in aops
+    arows = dict(zip(aops, agg.columns["rows"].tolist()))
+    assert arows["aggregate"] == 4

@@ -91,3 +91,146 @@ def test_record_error_metadata():
     assert t.meta["last_error"].startswith("RuntimeError")
     assert len(t.meta["last_error"]) <= 200
     assert "full_error" in t.meta
+
+
+# ------------------------------------------------- obs layer (PR: obs)
+
+
+def test_histogram_percentiles():
+    from mosaic_tpu.obs import Histogram
+    h = Histogram("t")
+    for v in [0.001] * 95 + [1.0] * 5:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert abs(snap["sum"] - (0.095 + 5.0)) < 1e-9
+    assert snap["min"] == 0.001 and snap["max"] == 1.0
+    # exponential buckets are ~19% wide: p50 lands in 0.001's bucket,
+    # p99 in the 1.0 tail (upper edge clipped to the observed max)
+    assert 0.001 <= snap["p50"] < 0.0013
+    assert 0.5 <= snap["p99"] <= 1.0
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+def test_span_report_percentiles(clean_tracer):
+    for _ in range(20):
+        with clean_tracer.span("stage"):
+            pass
+    s = clean_tracer.report()["spans"]["stage"]
+    assert s["calls"] == 20
+    assert 0.0 <= s["p50_s"] <= s["p95_s"] <= s["p99_s"] <= s["max_s"]
+    assert "p95" in clean_tracer.format_report()
+
+
+def test_metrics_registry(clean_tracer):
+    from mosaic_tpu.obs import metrics
+    assert metrics.enabled        # tracer.enable() turns metrics on
+    metrics.count("x", 2)
+    metrics.count("x", 3)
+    metrics.gauge("g", 1.5)
+    metrics.gauge_max("gm", 1.0)
+    metrics.gauge_max("gm", 3.0)
+    metrics.gauge_max("gm", 2.0)
+    metrics.observe("lat_s", 0.01)
+    rep = metrics.report()
+    assert rep["counters"]["x"] == 5
+    assert rep["gauges"]["g"] == 1.5 and rep["gauges"]["gm"] == 3.0
+    assert rep["histograms"]["lat_s"]["count"] == 1
+    # registry values merge into the tracer's one-stop report
+    trep = clean_tracer.report()
+    assert trep["counters"]["x"] == 5
+    assert trep["gauges"]["gm"] == 3.0
+
+
+def test_disabled_metrics_record_nothing():
+    from mosaic_tpu.obs import metrics
+    tracer.reset()
+    tracer.disable()
+    assert not metrics.enabled
+    metrics.count("nope", 1)
+    metrics.gauge("nope_g", 1.0)
+    metrics.observe("nope_h", 1.0)
+    rep = metrics.report()
+    assert rep["counters"] == {} and rep["gauges"] == {}
+    assert rep["histograms"] == {}
+
+
+def test_recompile_counter_attribution(clean_tracer):
+    import jax
+    import jax.numpy as jnp
+    from mosaic_tpu.obs import install_jax_listeners
+    install_jax_listeners()
+    # a fresh lambda is a fresh jit cache entry -> guaranteed compile
+    with clean_tracer.span("obs_test_compile"):
+        jax.block_until_ready(
+            jax.jit(lambda x: x * 1.234567 + 0.89)(jnp.arange(8.0)))
+    rep = clean_tracer.report()
+    assert rep["counters"].get("jax/recompiles", 0) >= 1
+    assert rep["counters"].get("jax/recompiles/obs_test_compile", 0) >= 1
+    assert rep["histograms"]["jax/compile_s"]["count"] >= 1
+
+
+def test_chrome_trace_export(tmp_path, clean_tracer):
+    import json
+    with clean_tracer.span("outer"):
+        with clean_tracer.span("inner"):
+            pass
+    from mosaic_tpu.obs import chrome_trace_events, export_chrome_trace
+    doc = chrome_trace_events()
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in evs}
+    assert {"outer", "outer/inner"} <= names
+    for e in evs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid", "cat"} \
+            <= set(e)
+        assert e["ts"] > 0 and e["dur"] >= 0
+    path = tmp_path / "trace.json"
+    export_chrome_trace(str(path))
+    ondisk = json.loads(path.read_text())
+    assert ondisk["displayTimeUnit"] == "ms"
+    assert any(e.get("ph") == "X" for e in ondisk["traceEvents"])
+
+
+def test_collective_accounting_exchange(clean_tracer):
+    from mosaic_tpu.parallel.overlay import _account_exchange
+    cells = np.arange(32, dtype=np.int64)
+    valid = np.ones(32, bool)
+    _account_exchange("unit", 4, 64, 8, 4, cells, valid)
+    rep = clean_tracer.report()
+    # per row: cell i64 + id i32 + [8,4] f32 edges + valid bool
+    row_bytes = 8 + 4 + 8 * 16 + 1
+    assert rep["counters"]["collective/all_to_all_bytes"] == \
+        4 * 4 * 64 * row_bytes
+    assert rep["counters"]["collective/all_to_all_calls"] == 4
+    assert rep["gauges"]["shard/skew/unit"] >= 1.0
+    assert rep["gauges"]["shard/rows_max/unit"] >= 1.0
+
+
+def test_ppermute_bytes_sharded_convolve(clean_tracer):
+    import jax
+    from jax.sharding import Mesh
+    from mosaic_tpu.parallel.raster_halo import sharded_convolve
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs a multi-device mesh")
+    D = len(devs)
+    gt = GeoTransform(0.0, 0.1, 0.0, 10.0, 0.0, -0.1)
+    tile = RasterTile(
+        np.arange(D * 4 * 16, dtype=np.float64).reshape(1, D * 4, 16),
+        gt)
+    mesh = Mesh(np.array(devs), ("data",))
+    sharded_convolve(tile, np.ones((3, 3)) / 9.0, mesh)
+    rep = clean_tracer.report()
+    # 2 ppermute shifts x D devices x bands*halo*W f32 rows
+    assert rep["counters"]["collective/ppermute_bytes"] == \
+        2.0 * D * 1 * 1 * 16 * 4
+    assert rep["counters"]["collective/ppermute_calls"] == 2
+    assert "halo/convolve" in rep["spans"]
+
+
+def test_utils_trace_shim_is_obs():
+    # back-compat: utils.trace re-exports the obs singletons
+    from mosaic_tpu import obs
+    from mosaic_tpu.utils import trace as shim
+    assert shim.tracer is obs.tracer
+    assert shim.metrics is obs.metrics
