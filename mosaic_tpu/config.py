@@ -27,10 +27,22 @@ MOSAIC_RASTER_BLOCKSIZE = "mosaic.raster.blocksize"
 MOSAIC_TRACE_ENABLED = "mosaic.trace.enabled"
 MOSAIC_METRICS_ENABLED = "mosaic.metrics.enabled"
 MOSAIC_CRS_STRICT_DATUM = "mosaic.crs.strict.datum"
+# Precision-policy keys (fields existed since round 1; the conf spelling
+# maps onto them so conf-driven deployments can set the policy too).
+MOSAIC_DEVICE_DTYPE = "mosaic.device.dtype"
+MOSAIC_EXACT_FALLBACK = "mosaic.exact.fallback"
+# Ingestion error policy (see mosaic_tpu/resilience/ingest.py):
+# "raise" fail-fast (default), "skip" drop malformed records, "null"
+# null/zero-fill them — every codec threads this through.
+MOSAIC_IO_ON_ERROR = "mosaic.io.on.error"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
 MOSAIC_RASTER_BLOCKSIZE_DEFAULT = 128
+
+
+class ConfigError(ValueError):
+    """A conf key carried an unusable value; the message names the key."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,30 +75,96 @@ class MosaicConfig:
     # an identity datum shift because the EPSG registry carries no
     # Helmert parameters for the code (helmert_acc is NaN).
     crs_strict_datum: bool = False
+    # Codec error policy (resilience/ingest.py): what a malformed
+    # record/strip/message does — fail fast, get dropped, or get nulled.
+    io_on_error: str = "raise"
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
-        """Build from a reference-style string conf map."""
-        def _flag(key):
-            return str(confs.get(key, "false")).lower() == "true"
+        """Build from a reference-style string conf map.
 
-        return MosaicConfig(
-            index_system=confs.get(MOSAIC_INDEX_SYSTEM, "H3"),
-            geometry_api=confs.get(MOSAIC_GEOMETRY_API, "JAX"),
-            raster_checkpoint=confs.get(
-                MOSAIC_RASTER_CHECKPOINT, MOSAIC_RASTER_CHECKPOINT_DEFAULT),
-            raster_use_checkpoint=str(
-                confs.get(MOSAIC_RASTER_USE_CHECKPOINT, "false")).lower()
-                == "true",
-            raster_tmp_prefix=confs.get(
-                MOSAIC_RASTER_TMP_PREFIX, MOSAIC_RASTER_TMP_PREFIX_DEFAULT),
-            raster_blocksize=int(
-                confs.get(MOSAIC_RASTER_BLOCKSIZE,
-                          MOSAIC_RASTER_BLOCKSIZE_DEFAULT)),
-            trace_enabled=_flag(MOSAIC_TRACE_ENABLED),
-            metrics_enabled=_flag(MOSAIC_METRICS_ENABLED),
-            crs_strict_datum=_flag(MOSAIC_CRS_STRICT_DATUM),
-        )
+        Every known key is validated — a bad value raises
+        :class:`ConfigError` naming the key; unknown keys are ignored
+        (reference behaviour: Spark confs are an open namespace)."""
+        cfg = MosaicConfig()
+        for key in confs:
+            if key in _CONF_FIELDS:
+                cfg = apply_conf(cfg, key, confs[key])
+        return cfg
+
+
+# ------------------------------------------------ conf-key validation
+
+def _as_flag(key: str, value) -> bool:
+    s = str(value).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise ConfigError(f"{key}={value!r} is not a boolean "
+                      "(use true/false)")
+
+
+def _as_blocksize(key: str, value) -> int:
+    try:
+        n = int(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not an integer") from None
+    if n <= 0:
+        raise ConfigError(f"{key}={n} must be a positive integer")
+    return n
+
+
+def _as_device_dtype(key: str, value) -> str:
+    s = str(value).strip().lower()
+    if s not in ("float32", "float64"):
+        raise ConfigError(f"{key}={value!r} unsupported "
+                          "(float32 or float64)")
+    return s
+
+
+def _as_on_error(key: str, value) -> str:
+    s = str(value).strip().lower()
+    if s not in ("raise", "skip", "null"):
+        raise ConfigError(f"{key}={value!r} invalid "
+                          "(raise, skip, or null)")
+    return s
+
+
+def _as_str(key: str, value) -> str:
+    return str(value)
+
+
+#: conf key -> (dataclass field, validating coercer)
+_CONF_FIELDS = {
+    MOSAIC_INDEX_SYSTEM: ("index_system", _as_str),
+    MOSAIC_GEOMETRY_API: ("geometry_api", _as_str),
+    MOSAIC_RASTER_CHECKPOINT: ("raster_checkpoint", _as_str),
+    MOSAIC_RASTER_USE_CHECKPOINT: ("raster_use_checkpoint", _as_flag),
+    MOSAIC_RASTER_TMP_PREFIX: ("raster_tmp_prefix", _as_str),
+    MOSAIC_RASTER_BLOCKSIZE: ("raster_blocksize", _as_blocksize),
+    MOSAIC_DEVICE_DTYPE: ("device_dtype", _as_device_dtype),
+    MOSAIC_EXACT_FALLBACK: ("exact_fallback", _as_flag),
+    MOSAIC_TRACE_ENABLED: ("trace_enabled", _as_flag),
+    MOSAIC_METRICS_ENABLED: ("metrics_enabled", _as_flag),
+    MOSAIC_CRS_STRICT_DATUM: ("crs_strict_datum", _as_flag),
+    MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
+}
+
+
+def apply_conf(cfg: MosaicConfig, key: str, value) -> MosaicConfig:
+    """One validated conf assignment -> a new config.
+
+    Unlike :meth:`MosaicConfig.from_confs` (open namespace), a key this
+    build does not know raises — this is the ``SET`` statement /
+    programmatic path where a typo should not vanish silently."""
+    if key not in _CONF_FIELDS:
+        raise ConfigError(
+            f"unknown conf key {key!r} (known: "
+            f"{', '.join(sorted(_CONF_FIELDS))})")
+    field, coerce = _CONF_FIELDS[key]
+    return dataclasses.replace(cfg, **{field: coerce(key, value)})
 
 
 _default_config: MosaicConfig = MosaicConfig()

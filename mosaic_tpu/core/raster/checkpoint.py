@@ -24,6 +24,8 @@ from typing import Optional
 import numpy as np
 
 from ... import config as _config
+from ...resilience import faults
+from ...resilience.retry import CHECKPOINT_RETRY
 from .gtiff import read_gtiff, write_gtiff
 from .tile import RasterTile
 
@@ -83,16 +85,21 @@ def serialize_tile(tile: RasterTile,
     name = hashlib.sha256(payload).hexdigest()[:24] + ".tif"
     path = os.path.join(cfg.raster_checkpoint, name)
     if not os.path.exists(path):
-        fd, tmp = tempfile.mkstemp(dir=cfg.raster_checkpoint,
-                                   suffix=".tmp")
-        os.close(fd)
-        try:
-            with open(tmp, "wb") as f:
-                f.write(payload)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        def _write():
+            faults.maybe_fail("checkpoint.write")
+            fd, tmp = tempfile.mkstemp(dir=cfg.raster_checkpoint,
+                                       suffix=".tmp")
+            os.close(fd)
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        # transient volume hiccups (NFS blip, ENOSPC race with the GC)
+        # retry with backoff instead of failing the batch
+        CHECKPOINT_RETRY.call(_write)
     meta["checkpoint_path"] = path
     return {"cell_id": tile.cell_id, "raster": path, "metadata": meta}
 
@@ -104,8 +111,11 @@ def deserialize_tile(rec: dict) -> RasterTile:
     if isinstance(raster, (bytes, bytearray)):
         tile = read_gtiff(bytes(raster))
     else:
-        with open(raster, "rb") as f:
-            tile = read_gtiff(f.read())
+        def _read():
+            faults.maybe_fail("checkpoint.read")
+            with open(raster, "rb") as f:
+                return f.read()
+        tile = read_gtiff(CHECKPOINT_RETRY.call(_read), path=raster)
     return dataclasses.replace(
         tile, cell_id=rec.get("cell_id"),
         meta=dict(tile.meta, **rec.get("metadata", {})))

@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...resilience import faults
+from ...resilience.ingest import ErrorSink, decode_guard
 from .tile import GeoTransform, RasterTile
 
 __all__ = ["read_gtiff", "write_gtiff"]
@@ -138,9 +140,20 @@ def _epsg_from_geokeys(entry, bo: str) -> Optional[int]:
     return projected if projected is not None else geographic
 
 
-def read_gtiff(data: bytes) -> RasterTile:
+def read_gtiff(data: bytes, on_error: Optional[str] = None,
+               path: Optional[str] = None) -> RasterTile:
     """Decode GeoTIFF bytes into a RasterTile (reference entry:
-    GDAL.readRaster, core/raster/api/GDAL.scala:117)."""
+    GDAL.readRaster, core/raster/api/GDAL.scala:117).
+
+    ``on_error`` (default: ``MosaicConfig.io_on_error``) governs
+    malformed strips/tiles: ``"raise"`` fails fast with a located
+    ``CodecError``; ``"skip"`` leaves the damaged region zeroed;
+    ``"null"`` fills it with the nodata value (NaN for float samples
+    without one).  Dropped regions are stamped into
+    ``tile.meta["decode_errors"]``.  ``path`` is advisory error
+    context only (the payload always arrives as bytes)."""
+    faults.maybe_fail("gtiff.read")
+    sink = ErrorSink(on_error, driver="gtiff", path=path)
     if len(data) < 8:
         raise ValueError("not a TIFF: truncated header")
     if data[:2] == b"II":
@@ -155,23 +168,26 @@ def read_gtiff(data: bytes) -> RasterTile:
                          "< 4GB per file)")
     if magic != 42:
         raise ValueError(f"not a TIFF: magic {magic}")
-    (ifd_off,) = struct.unpack_from(bo + "I", data, 4)
-    tags, _ = _read_ifd_entries(data, ifd_off, bo)
+    # the IFD is load-bearing for the whole file — header damage is
+    # never skippable, but it must surface located, not as struct.error
+    with decode_guard(path=path, feature="IFD"):
+        (ifd_off,) = struct.unpack_from(bo + "I", data, 4)
+        tags, _ = _read_ifd_entries(data, ifd_off, bo)
 
-    def val(tag, default=None):
-        if tag not in tags:
-            return default
-        v = _values(tags[tag], bo)
-        return v
+        def val(tag, default=None):
+            if tag not in tags:
+                return default
+            v = _values(tags[tag], bo)
+            return v
 
-    width = int(val(_TAG_WIDTH)[0])
-    height = int(val(_TAG_HEIGHT)[0])
-    spp = int(val(_TAG_SAMPLES_PER_PIXEL, [1])[0])
-    bits = val(_TAG_BITS, [8])
-    fmtv = val(_TAG_SAMPLE_FORMAT, [1] * spp)
-    comp = int(val(_TAG_COMPRESSION, [1])[0])
-    planar = int(val(_TAG_PLANAR, [1])[0])
-    predictor = int(val(_TAG_PREDICTOR, [1])[0])
+        width = int(val(_TAG_WIDTH)[0])
+        height = int(val(_TAG_HEIGHT)[0])
+        spp = int(val(_TAG_SAMPLES_PER_PIXEL, [1])[0])
+        bits = val(_TAG_BITS, [8])
+        fmtv = val(_TAG_SAMPLE_FORMAT, [1] * spp)
+        comp = int(val(_TAG_COMPRESSION, [1])[0])
+        planar = int(val(_TAG_PLANAR, [1])[0])
+        predictor = int(val(_TAG_PREDICTOR, [1])[0])
     if comp not in (1, 8, 32773, 32946):
         raise ValueError(f"unsupported TIFF compression {comp} "
                          "(supported: none, deflate, packbits)")
@@ -185,6 +201,19 @@ def read_gtiff(data: bytes) -> RasterTile:
         if comp == 32773:
             return _unpackbits(chunk, nbytes)
         return chunk
+
+    nodata = None
+    if _TAG_GDAL_NODATA in tags:
+        txt = val(_TAG_GDAL_NODATA).split(b"\x00")[0]
+        try:
+            nodata = float(txt)
+        except ValueError:
+            nodata = None
+    # null-mode fill for a dropped strip/tile region
+    if nodata is not None:
+        fill = dt.type(nodata)
+    else:
+        fill = np.nan if dt.kind == "f" else 0
 
     out = np.zeros((spp, height, width), dt.newbyteorder("="))
 
@@ -200,23 +229,38 @@ def read_gtiff(data: bytes) -> RasterTile:
             plane = ti // per_plane if planar == 2 else 0
             idx = ti % per_plane if planar == 2 else ti
             ty, tx = divmod(idx, tiles_x)
-            nb = tw * th * dt.itemsize * (spp if planar == 1 else 1)
-            raw = decode(data[o:o + c], nb)
-            if planar == 1:
-                arr = np.frombuffer(raw, dt, count=tw * th * spp)
-                arr = arr.reshape(th, tw, spp)
-                if predictor == 2:
-                    # differencing is per component along the pixel axis
-                    arr = np.cumsum(arr, axis=1, dtype=arr.dtype)
-                arr = np.moveaxis(arr, -1, 0)
-            else:
-                arr = np.frombuffer(raw, dt, count=tw * th)
-                arr = arr.reshape(1, th, tw)
-                if predictor == 2:
-                    arr = _undo_predictor(arr, predictor)
             y0, x0 = ty * th, tx * tw
             hh = min(th, height - y0)
             ww = min(tw, width - x0)
+            nb = tw * th * dt.itemsize * (spp if planar == 1 else 1)
+            chunk = faults.corrupt("gtiff.read_strip", data[o:o + c])
+            try:
+                with decode_guard(path=path, feature=f"tile {ti}",
+                                  offset=o):
+                    raw = decode(chunk, nb)
+                    if planar == 1:
+                        arr = np.frombuffer(raw, dt,
+                                            count=tw * th * spp)
+                        arr = arr.reshape(th, tw, spp)
+                        if predictor == 2:
+                            # differencing is per component along the
+                            # pixel axis
+                            arr = np.cumsum(arr, axis=1,
+                                            dtype=arr.dtype)
+                        arr = np.moveaxis(arr, -1, 0)
+                    else:
+                        arr = np.frombuffer(raw, dt, count=tw * th)
+                        arr = arr.reshape(1, th, tw)
+                        if predictor == 2:
+                            arr = _undo_predictor(arr, predictor)
+            except ValueError as e:
+                sink.handle(e)
+                if sink.on_error == "null":
+                    if planar == 1:
+                        out[:, y0:y0 + hh, x0:x0 + ww] = fill
+                    else:
+                        out[plane, y0:y0 + hh, x0:x0 + ww] = fill
+                continue
             if planar == 1:
                 out[:, y0:y0 + hh, x0:x0 + ww] = arr[:, :hh, :ww]
             else:
@@ -232,20 +276,39 @@ def read_gtiff(data: bytes) -> RasterTile:
             y0 = idx * rps
             nrows = min(rps, height - y0)
             nb = nrows * width * dt.itemsize * (spp if planar == 1 else 1)
-            raw = decode(data[o:o + c], nb)
+            chunk = faults.corrupt("gtiff.read_strip", data[o:o + c])
+            try:
+                with decode_guard(path=path, feature=f"strip {si}",
+                                  offset=o):
+                    raw = decode(chunk, nb)
+                    if planar == 1:
+                        arr = np.frombuffer(raw, dt,
+                                            count=nrows * width * spp)
+                        arr = arr.reshape(nrows, width, spp)
+                        if predictor == 2:
+                            # differencing is per component along the
+                            # pixel axis
+                            arr = np.cumsum(arr, axis=1,
+                                            dtype=arr.dtype)
+                        arr = np.moveaxis(arr, -1, 0)
+                    else:
+                        arr = np.frombuffer(raw, dt,
+                                            count=nrows * width)
+                        arr = arr.reshape(1, nrows, width)
+                        if predictor == 2:
+                            arr = _undo_predictor(arr, 2)
+            except ValueError as e:
+                sink.handle(e)
+                if sink.on_error == "null":
+                    if planar == 1:
+                        out[:, y0:y0 + nrows] = fill
+                    else:
+                        out[plane, y0:y0 + nrows] = fill
+                continue
             if planar == 1:
-                arr = np.frombuffer(raw, dt, count=nrows * width * spp)
-                arr = arr.reshape(nrows, width, spp)
-                if predictor == 2:
-                    # differencing is per component along the pixel axis
-                    arr = np.cumsum(arr, axis=1, dtype=arr.dtype)
-                out[:, y0:y0 + nrows] = np.moveaxis(arr, -1, 0)
+                out[:, y0:y0 + nrows] = arr
             else:
-                arr = np.frombuffer(raw, dt, count=nrows * width)
-                arr = arr.reshape(nrows, width)
-                if predictor == 2:
-                    arr = _undo_predictor(arr, 2)
-                out[plane, y0:y0 + nrows] = arr
+                out[plane, y0:y0 + nrows] = arr[0]
 
     # geo referencing
     if _TAG_MODEL_TRANSFORM in tags:
@@ -260,17 +323,13 @@ def read_gtiff(data: bytes) -> RasterTile:
     else:
         gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
 
-    nodata = None
-    if _TAG_GDAL_NODATA in tags:
-        txt = val(_TAG_GDAL_NODATA).split(b"\x00")[0]
-        try:
-            nodata = float(txt)
-        except ValueError:
-            nodata = None
     srid = _epsg_from_geokeys(tags[_TAG_GEO_KEYS], bo) \
         if _TAG_GEO_KEYS in tags else 4326
+    meta = {"driver": "GTiff"}
+    if sink.records:
+        meta["decode_errors"] = sink.meta_records()
     return RasterTile(out, gt, nodata=nodata, srid=srid or 4326,
-                      meta={"driver": "GTiff"})
+                      meta=meta)
 
 
 # ------------------------------------------------------------------ write

@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.geometry.array import GeometryArray
 from ..core.geometry.wkb import read_wkb, write_wkb
+from ..resilience import faults
+from ..resilience.ingest import CodecError, ErrorSink, decode_guard
 
 __all__ = ["read_gpkg", "write_gpkg", "gpkg_layers"]
 
@@ -62,13 +64,23 @@ def gpkg_layers(path: str) -> List[str]:
         con.close()
 
 
-def read_gpkg(path: str, layer: Optional[str] = None
+def read_gpkg(path: str, layer: Optional[str] = None,
+              on_error: Optional[str] = None,
+              errors: Optional[list] = None
               ) -> Tuple[GeometryArray, Dict[str, list]]:
     """One layer (default: the first) -> (geometries, attribute columns).
 
     NULL/empty geometry rows are dropped (the reference's OGR path
     yields null rows Spark then filters; the columnar batch has no null
-    geometry slot)."""
+    geometry slot).
+
+    ``on_error`` (default: ``MosaicConfig.io_on_error``) governs rows
+    with a malformed geometry blob: ``"raise"`` fails fast with a
+    located ``CodecError``; ``"skip"``/``"null"`` drop the row (same
+    fate as a NULL geometry — there is no null geometry slot) and
+    append ErrorRecords to ``errors`` when a list is supplied."""
+    faults.maybe_fail("gpkg.read")
+    sink = ErrorSink(on_error, driver="gpkg", path=path)
     con = sqlite3.connect(path)
     try:
         layers = con.execute(
@@ -94,16 +106,44 @@ def read_gpkg(path: str, layer: Optional[str] = None
         attrs = [c for c in cols if c != gcol]
         sel = ", ".join([f'"{gcol}"'] + [f'"{c}"' for c in attrs])
         rows = con.execute(f'SELECT {sel} FROM "{table}"').fetchall()
+        srid = int(srs) if srs and int(srs) > 0 else 4326
         wkbs, keep = [], []
         for i, r in enumerate(rows):
-            w = _strip_gpb(r[0])
+            try:
+                with decode_guard(path=path, feature=f"row {i}"):
+                    faults.maybe_fail("gpkg.read_row")
+                    blob = r[0]
+                    if blob is not None:
+                        blob = faults.corrupt("gpkg.read_row", blob)
+                    w = _strip_gpb(blob)
+            except ValueError as e:
+                sink.handle(e)
+                continue
             if w is not None:
                 wkbs.append(w)
                 keep.append(i)
-        srid = int(srs) if srs and int(srs) > 0 else 4326
-        geoms = read_wkb(wkbs, srid=srid)
+        try:
+            with decode_guard(path=path, feature=table):
+                geoms = read_wkb(wkbs, srid=srid)
+        except ValueError as e:
+            if sink.raising:
+                raise
+            # one bad WKB poisoned the batch: salvage row by row
+            good_wkbs, good_keep = [], []
+            for w, i in zip(wkbs, keep):
+                try:
+                    with decode_guard(path=path, feature=f"row {i}"):
+                        read_wkb([w], srid=srid)
+                except ValueError as row_e:
+                    sink.handle(row_e)
+                    continue
+                good_wkbs.append(w)
+                good_keep.append(i)
+            geoms = read_wkb(good_wkbs, srid=srid)
+            keep = good_keep
         out = {c: [rows[i][j + 1] for i in keep]
                for j, c in enumerate(attrs)}
+        sink.export(errors)
         return geoms, out
     finally:
         con.close()

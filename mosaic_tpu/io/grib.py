@@ -26,11 +26,13 @@ bands), georeferenced from the lat/lon grid section.
 from __future__ import annotations
 
 import struct
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.raster.tile import GeoTransform, RasterTile
+from ..resilience import faults
+from ..resilience.ingest import CodecError, ErrorSink, decode_guard
 
 __all__ = ["read_grib", "grib_subdatasets"]
 
@@ -143,8 +145,104 @@ def _read_grib1(data: bytes, off: int, total: int, mi: int,
                                "param": str(param)}, out)
 
 
-def read_grib(data: bytes) -> Dict[str, RasterTile]:
-    """GRIB bytes -> {subdataset_name: RasterTile} per message."""
+def _read_grib2(data: bytes, off: int, end: int, mi: int,
+                out: Dict[str, RasterTile]) -> None:
+    """One GRIB2 message: section loop from ``off`` to ``end``."""
+    discipline = data[off + 6]
+    pos = off + 16
+    grid = None
+    repr_ = None
+    bitmap = None
+    cat = num = None
+    fi = 0
+    while pos < end - 4:
+        slen = _i(data[pos:pos + 4])
+        if slen == 0 or data[pos:pos + 4] == b"7777":
+            break
+        snum = data[pos + 4]
+        sec = data[pos:pos + slen]
+        if snum == 3:
+            tmpl = _i(sec[12:14])
+            if tmpl != 0:
+                raise ValueError(
+                    f"GRIB2 grid template 3.{tmpl} unsupported "
+                    "(regular lat/lon 3.0 only)")
+            ni = _i(sec[30:34])
+            nj = _i(sec[34:38])
+            la1 = _sgn(_i(sec[46:50]), 32) / 1e6
+            lo1 = _sgn(_i(sec[50:54]), 32) / 1e6
+            la2 = _sgn(_i(sec[55:59]), 32) / 1e6
+            lo2 = _sgn(_i(sec[59:63]), 32) / 1e6
+            di = _sgn(_i(sec[63:67]), 32) / 1e6
+            dj = _sgn(_i(sec[67:71]), 32) / 1e6
+            scan = sec[71]
+            grid = (ni, nj, la1, lo1, la2, lo2, di, dj, scan)
+        elif snum == 4:
+            cat, num = sec[9], sec[10]
+        elif snum == 5:
+            tmpl = _i(sec[9:11])
+            if tmpl != 0:
+                raise ValueError(
+                    f"GRIB2 data representation 5.{tmpl} "
+                    "unsupported (simple packing 5.0 only)")
+            ndata = _i(sec[5:9])
+            R = struct.unpack(">f", sec[11:15])[0]
+            E = _sgn(_i(sec[15:17]), 16)
+            D = _sgn(_i(sec[17:19]), 16)
+            nbits = sec[19]
+            repr_ = (ndata, R, E, D, nbits)
+        elif snum == 6:
+            ind = sec[5]
+            if ind == 0:
+                bitmap = np.unpackbits(
+                    np.frombuffer(sec[6:], np.uint8)).astype(bool)
+            elif ind == 255:
+                # no bitmap applies to THIS field — clear any
+                # bitmap a previous field in the message set
+                bitmap = None
+            else:
+                raise ValueError(
+                    f"GRIB2 bitmap indicator {ind} unsupported")
+        elif snum == 7:
+            if grid is None or repr_ is None:
+                raise ValueError(
+                    "data section before grid/representation sections")
+            ni, nj, la1, lo1, la2, lo2, di, dj, scan = grid
+            ndata, R, E, D, nbits = repr_
+            packed = _unpack_bits(sec[5:], nbits, ndata)
+            vals = (R + packed.astype(np.float64) * 2.0 ** E) / \
+                (10.0 ** D)
+            full = np.full(ni * nj, np.nan)
+            if bitmap is not None:
+                full[np.nonzero(bitmap[:ni * nj])[0][:ndata]] = vals
+            else:
+                full[:ndata] = vals
+            # fi disambiguates repeated 4-7 groups in one message
+            # sharing (discipline, category, number), e.g. the same
+            # parameter at several levels
+            name = f"d{discipline}c{cat}n{num}_{mi}_{fi}"
+            fi += 1
+            _grid_to_tile(full.reshape(nj, ni), la1, lo1, la2,
+                          lo2, di, dj, scan, name,
+                          {"driver": "GRIB", "edition": "2",
+                           "discipline": str(discipline),
+                           "category": str(cat),
+                           "number": str(num)}, out)
+        pos += slen
+
+
+def read_grib(data: bytes, on_error: Optional[str] = None,
+              path: Optional[str] = None,
+              errors: Optional[list] = None) -> Dict[str, RasterTile]:
+    """GRIB bytes -> {subdataset_name: RasterTile} per message.
+
+    ``on_error`` (default: ``MosaicConfig.io_on_error``) governs
+    malformed/unsupported messages: ``"raise"`` fails fast with a
+    located ``CodecError``; ``"skip"``/``"null"`` drop the damaged
+    message (there is no null raster slot), keep decoding the intact
+    remainder, and append ErrorRecords to ``errors`` when a list is
+    supplied."""
+    sink = ErrorSink(on_error, driver="grib", path=path)
     out: Dict[str, RasterTile] = {}
     off = 0
     mi = 0
@@ -154,101 +252,39 @@ def read_grib(data: bytes) -> Dict[str, RasterTile]:
         off = data.find(b"GRIB", off)
         if off < 0 or off + 16 > n:
             break
-        if data[off + 7] == 1:
+        edition = data[off + 7]
+        feature = f"message {mi}"
+        if edition == 1:
             total = _i(data[off + 4:off + 7])
-            _read_grib1(data, off, total, mi, out)
-            off += total
+        elif edition == 2:
+            total = _i(data[off + 8:off + 16])
+        else:
+            sink.handle(CodecError(
+                f"GRIB edition {edition} unsupported", path=path,
+                feature=feature, offset=off))
+            off += 4
             mi += 1
             continue
-        if data[off + 7] != 2:
-            raise ValueError(
-                f"GRIB edition {data[off + 7]} unsupported")
-        discipline = data[off + 6]
-        total = _i(data[off + 8:off + 16])
-        pos = off + 16
-        end = off + total
-        grid = None
-        repr_ = None
-        bitmap = None
-        cat = num = None
-        fi = 0
-        while pos < end - 4:
-            slen = _i(data[pos:pos + 4])
-            if slen == 0 or data[pos:pos + 4] == b"7777":
-                break
-            snum = data[pos + 4]
-            sec = data[pos:pos + slen]
-            if snum == 3:
-                tmpl = _i(sec[12:14])
-                if tmpl != 0:
-                    raise ValueError(
-                        f"GRIB2 grid template 3.{tmpl} unsupported "
-                        "(regular lat/lon 3.0 only)")
-                ni = _i(sec[30:34])
-                nj = _i(sec[34:38])
-                la1 = _sgn(_i(sec[46:50]), 32) / 1e6
-                lo1 = _sgn(_i(sec[50:54]), 32) / 1e6
-                la2 = _sgn(_i(sec[55:59]), 32) / 1e6
-                lo2 = _sgn(_i(sec[59:63]), 32) / 1e6
-                di = _sgn(_i(sec[63:67]), 32) / 1e6
-                dj = _sgn(_i(sec[67:71]), 32) / 1e6
-                scan = sec[71]
-                grid = (ni, nj, la1, lo1, la2, lo2, di, dj, scan)
-            elif snum == 4:
-                cat, num = sec[9], sec[10]
-            elif snum == 5:
-                tmpl = _i(sec[9:11])
-                if tmpl != 0:
-                    raise ValueError(
-                        f"GRIB2 data representation 5.{tmpl} "
-                        "unsupported (simple packing 5.0 only)")
-                ndata = _i(sec[5:9])
-                R = struct.unpack(">f", sec[11:15])[0]
-                E = _sgn(_i(sec[15:17]), 16)
-                D = _sgn(_i(sec[17:19]), 16)
-                nbits = sec[19]
-                repr_ = (ndata, R, E, D, nbits)
-            elif snum == 6:
-                ind = sec[5]
-                if ind == 0:
-                    bitmap = np.unpackbits(
-                        np.frombuffer(sec[6:], np.uint8)).astype(bool)
-                elif ind == 255:
-                    # no bitmap applies to THIS field — clear any
-                    # bitmap a previous field in the message set
-                    bitmap = None
+        # a corrupt length field must not swallow the rest of the file:
+        # advance by the declared total only when it stays in bounds,
+        # else resync on the next magic
+        sane = 16 < total <= n - off if edition == 2 else \
+            8 < total <= n - off
+        try:
+            with decode_guard(path=path, feature=feature, offset=off):
+                faults.maybe_fail("grib.read_message")
+                if edition == 1:
+                    _read_grib1(data, off, total, mi, out)
                 else:
-                    raise ValueError(
-                        f"GRIB2 bitmap indicator {ind} unsupported")
-            elif snum == 7:
-                assert grid is not None and repr_ is not None, \
-                    "data section before grid/representation sections"
-                ni, nj, la1, lo1, la2, lo2, di, dj, scan = grid
-                ndata, R, E, D, nbits = repr_
-                packed = _unpack_bits(sec[5:], nbits, ndata)
-                vals = (R + packed.astype(np.float64) * 2.0 ** E) / \
-                    (10.0 ** D)
-                full = np.full(ni * nj, np.nan)
-                if bitmap is not None:
-                    full[np.nonzero(bitmap[:ni * nj])[0][:ndata]] = vals
-                else:
-                    full[:ndata] = vals
-                # fi disambiguates repeated 4-7 groups in one message
-                # sharing (discipline, category, number), e.g. the same
-                # parameter at several levels
-                name = f"d{discipline}c{cat}n{num}_{mi}_{fi}"
-                fi += 1
-                _grid_to_tile(full.reshape(nj, ni), la1, lo1, la2,
-                              lo2, di, dj, scan, name,
-                              {"driver": "GRIB", "edition": "2",
-                               "discipline": str(discipline),
-                               "category": str(cat),
-                               "number": str(num)}, out)
-            pos += slen
-        off = end
+                    _read_grib2(data, off, min(off + total, n), mi,
+                                out)
+        except ValueError as e:
+            sink.handle(e)
+        off = off + total if sane else off + 4
         mi += 1
-    if not out:
+    if not out and not sink.records:
         raise ValueError("no GRIB2 messages found")
+    sink.export(errors)
     return out
 
 

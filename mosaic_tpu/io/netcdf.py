@@ -21,6 +21,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.raster.tile import GeoTransform, RasterTile
+from ..resilience import faults
+from ..resilience.ingest import ErrorSink, decode_guard
 
 __all__ = ["read_netcdf", "write_netcdf", "netcdf_subdatasets"]
 
@@ -51,9 +53,21 @@ def _read_att_values(buf: bytes, i: int):
     return np.frombuffer(raw, dt, cnt), i
 
 
-def read_netcdf(data: bytes) -> Dict[str, RasterTile]:
+def read_netcdf(data: bytes, on_error: Optional[str] = None,
+                path: Optional[str] = None,
+                errors: Optional[list] = None) -> Dict[str, RasterTile]:
     """NetCDF-3 bytes -> {variable_name: RasterTile} for every 2D+
-    variable (leading dims beyond the last two become bands)."""
+    variable (leading dims beyond the last two become bands).
+
+    ``on_error`` (default: ``MosaicConfig.io_on_error``) governs
+    malformed variables: ``"raise"`` fails fast with a located
+    ``CodecError``; ``"skip"``/``"null"`` drop the damaged variable
+    (there is no null raster slot), keep the intact ones, and append
+    ErrorRecords to ``errors`` when a list is supplied.  Header damage
+    always raises — without the dimension/variable lists nothing can
+    be salvaged."""
+    faults.maybe_fail("netcdf.read")
+    sink = ErrorSink(on_error, driver="netcdf", path=path)
     if data[:3] != b"CDF":
         if data[:8] == b"\x89HDF\r\n\x1a\n" or data[:4] == b"\x89HDF":
             raise ValueError("NetCDF-4/HDF5 container not supported "
@@ -65,53 +79,54 @@ def read_netcdf(data: bytes) -> Dict[str, RasterTile]:
     off_fmt = ">i" if version == 1 else ">q"
     off_sz = 4 if version == 1 else 8
     i = 4
-    numrecs = struct.unpack(">i", data[i:i + 4])[0]
-    i += 4
 
     def read_tag_count(i):
         tag, cnt = struct.unpack(">ii", data[i:i + 8])
         return tag, cnt, i + 8
 
-    # dimensions
-    tag, ndims, i = read_tag_count(i)
-    dims: List[Tuple[str, int]] = []
-    if tag == 0x0A:
-        for _ in range(ndims):
-            name, i = _read_name(data, i)
-            size = struct.unpack(">i", data[i:i + 4])[0]
-            i += 4
-            dims.append((name, size))
-    # global attributes
-    tag, natt, i = read_tag_count(i)
-    gatts = {}
-    if tag == 0x0C:
-        for _ in range(natt):
-            name, i = _read_name(data, i)
-            val, i = _read_att_values(data, i)
-            gatts[name] = val
-    # variables
-    tag, nvars, i = read_tag_count(i)
-    variables = []
-    if tag == 0x0B:
-        for _ in range(nvars):
-            name, i = _read_name(data, i)
-            nd = struct.unpack(">i", data[i:i + 4])[0]
-            i += 4
-            dimids = struct.unpack(f">{nd}i", data[i:i + 4 * nd]) \
-                if nd else ()
-            i += 4 * nd
-            t2, na2, i = read_tag_count(i)
-            vatts = {}
-            if t2 == 0x0C:
-                for _ in range(na2):
-                    aname, i = _read_name(data, i)
-                    aval, i = _read_att_values(data, i)
-                    vatts[aname] = aval
-            tp, vsize = struct.unpack(">ii", data[i:i + 8])
-            i += 8
-            begin = struct.unpack(off_fmt, data[i:i + off_sz])[0]
-            i += off_sz
-            variables.append((name, dimids, vatts, tp, begin))
+    with decode_guard(path=path, feature="header"):
+        numrecs = struct.unpack(">i", data[i:i + 4])[0]
+        i += 4
+        # dimensions
+        tag, ndims, i = read_tag_count(i)
+        dims: List[Tuple[str, int]] = []
+        if tag == 0x0A:
+            for _ in range(ndims):
+                name, i = _read_name(data, i)
+                size = struct.unpack(">i", data[i:i + 4])[0]
+                i += 4
+                dims.append((name, size))
+        # global attributes
+        tag, natt, i = read_tag_count(i)
+        gatts = {}
+        if tag == 0x0C:
+            for _ in range(natt):
+                name, i = _read_name(data, i)
+                val, i = _read_att_values(data, i)
+                gatts[name] = val
+        # variables
+        tag, nvars, i = read_tag_count(i)
+        variables = []
+        if tag == 0x0B:
+            for _ in range(nvars):
+                name, i = _read_name(data, i)
+                nd = struct.unpack(">i", data[i:i + 4])[0]
+                i += 4
+                dimids = struct.unpack(f">{nd}i", data[i:i + 4 * nd]) \
+                    if nd else ()
+                i += 4 * nd
+                t2, na2, i = read_tag_count(i)
+                vatts = {}
+                if t2 == 0x0C:
+                    for _ in range(na2):
+                        aname, i = _read_name(data, i)
+                        aval, i = _read_att_values(data, i)
+                        vatts[aname] = aval
+                tp, vsize = struct.unpack(">ii", data[i:i + 8])
+                i += 8
+                begin = struct.unpack(off_fmt, data[i:i + off_sz])[0]
+                i += off_sz
+                variables.append((name, dimids, vatts, tp, begin))
 
     n_record_vars = sum(1 for _, dimids, _, _, _ in variables
                         if dimids and dims[dimids[0]][1] == 0)
@@ -136,43 +151,62 @@ def read_netcdf(data: bytes) -> Dict[str, RasterTile]:
     coord_vars = {}
     for name, dimids, vatts, tp, begin in variables:
         if len(dimids) == 1 and dims[dimids[0]][0] == name:
-            coord_vars[name] = var_array(name, dimids, tp, begin)
+            try:
+                with decode_guard(path=path,
+                                  feature=f"coordinate {name}",
+                                  offset=begin):
+                    coord_vars[name] = var_array(name, dimids, tp,
+                                                 begin)
+            except ValueError as e:
+                # a broken coordinate variable degrades to the default
+                # pixel-space geotransform, not a dead file
+                sink.handle(e)
 
     out: Dict[str, RasterTile] = {}
     for name, dimids, vatts, tp, begin in variables:
         if len(dimids) < 2:
             continue
-        arr = var_array(name, dimids, tp, begin).astype(np.float64)
-        ydim = dims[dimids[-2]][0]
-        xdim = dims[dimids[-1]][0]
-        h, w = arr.shape[-2], arr.shape[-1]
-        arr = arr.reshape(-1, h, w)
-        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
-        flip = False
-        if xdim in coord_vars and ydim in coord_vars and w > 1 and h > 1:
-            xs = coord_vars[xdim].astype(np.float64)
-            ys = coord_vars[ydim].astype(np.float64)
-            dx = float(xs[1] - xs[0])
-            dy = float(ys[1] - ys[0])
-            if dy > 0:                 # south-up storage: flip north-up
-                flip = True
-                ys = ys[::-1]
-                dy = -dy
-            gt = GeoTransform(float(xs[0]) - dx / 2, dx, 0.0,
-                              float(ys[0]) - dy / 2, 0.0, dy)
-        if flip:
-            arr = arr[:, ::-1, :]
-        nodata = None
-        for key in ("_FillValue", "missing_value"):
-            if key in vatts:
-                nodata = float(np.atleast_1d(vatts[key])[0])
-                break
-        out[name] = RasterTile(
-            arr, gt, nodata=nodata, srid=4326,
-            meta={"driver": "netcdf", "variable": name,
-                  **{f"attr_{k}": str(v) for k, v in vatts.items()}})
+        try:
+            with decode_guard(path=path, feature=f"variable {name}",
+                              offset=begin):
+                faults.maybe_fail("netcdf.read_var")
+                arr = var_array(name, dimids, tp,
+                                begin).astype(np.float64)
+                ydim = dims[dimids[-2]][0]
+                xdim = dims[dimids[-1]][0]
+                h, w = arr.shape[-2], arr.shape[-1]
+                arr = arr.reshape(-1, h, w)
+                gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+                flip = False
+                if xdim in coord_vars and ydim in coord_vars \
+                        and w > 1 and h > 1:
+                    xs = coord_vars[xdim].astype(np.float64)
+                    ys = coord_vars[ydim].astype(np.float64)
+                    dx = float(xs[1] - xs[0])
+                    dy = float(ys[1] - ys[0])
+                    if dy > 0:         # south-up storage: flip north-up
+                        flip = True
+                        ys = ys[::-1]
+                        dy = -dy
+                    gt = GeoTransform(float(xs[0]) - dx / 2, dx, 0.0,
+                                      float(ys[0]) - dy / 2, 0.0, dy)
+                if flip:
+                    arr = arr[:, ::-1, :]
+                nodata = None
+                for key in ("_FillValue", "missing_value"):
+                    if key in vatts:
+                        nodata = float(np.atleast_1d(vatts[key])[0])
+                        break
+                out[name] = RasterTile(
+                    arr, gt, nodata=nodata, srid=4326,
+                    meta={"driver": "netcdf", "variable": name,
+                          **{f"attr_{k}": str(v)
+                             for k, v in vatts.items()}})
+        except ValueError as e:
+            sink.handle(e)
     for t in out.values():
         t.meta["subdatasets"] = ",".join(sorted(out))
+    sink.export(errors)
     return out
 
 

@@ -24,6 +24,8 @@ import numpy as np
 
 from ..core.geometry.array import (GeometryArray, GeometryBuilder,
                                    GeometryType)
+from ..resilience import faults
+from ..resilience.ingest import ErrorSink, decode_guard
 
 __all__ = ["read_shapefile", "write_shapefile", "read_vector"]
 
@@ -104,12 +106,24 @@ def _prj_to_epsg(wkt: str) -> int:
     return 4326
 
 
-def read_shapefile(path: str) -> Tuple[GeometryArray, Dict[str, list]]:
+def read_shapefile(path: str, on_error: Optional[str] = None,
+                   errors: Optional[list] = None
+                   ) -> Tuple[GeometryArray, Dict[str, list]]:
     """path (.shp, or basename) -> (geometries, attribute columns).
 
     Null-shape records become empty geometries so row alignment with
-    the .dbf attributes is preserved."""
+    the .dbf attributes is preserved.
+
+    ``on_error`` (default: ``MosaicConfig.io_on_error``) governs
+    malformed records: ``"raise"`` fails fast with a located
+    ``CodecError``; ``"null"`` turns a damaged record into an empty
+    GEOMETRYCOLLECTION (keeping its attribute row); ``"skip"`` drops
+    the record AND its attribute row.  Unparseable .dbf numeric fields
+    degrade to None under skip/null.  ErrorRecords are appended to
+    ``errors`` when a list is supplied."""
+    faults.maybe_fail("shapefile.read")
     base = path[:-4] if path.lower().endswith(".shp") else path
+    sink = ErrorSink(on_error, driver="shapefile", path=base + ".shp")
     with open(base + ".shp", "rb") as f:
         buf = f.read()
     if len(buf) < 100 or struct.unpack(">i", buf[:4])[0] != 9994:
@@ -122,49 +136,71 @@ def read_shapefile(path: str) -> Tuple[GeometryArray, Dict[str, list]]:
     b = GeometryBuilder(srid=srid)
     off = 100
     n = 0
+    dropped: set = set()                # record indices skip removed
     while off + 8 <= len(buf):
+        rec_off = off
         _, clen = struct.unpack(">ii", buf[off:off + 8])
         rec = buf[off + 8: off + 8 + 2 * clen]
         off += 8 + 2 * clen
         if len(rec) < 4:
             break
-        st = struct.unpack("<i", rec[:4])[0]
+        rec = faults.corrupt("shapefile.read_record", rec)
         n += 1
-        if st == _SHP_NULL:
-            b.add(GeometryType.GEOMETRYCOLLECTION, [])
-        elif st in _SHP_POINT:
-            x, y = struct.unpack("<2d", rec[4:20])
-            b.add_point(np.array([x, y]))
-        elif st in _SHP_MPOINT:
-            npts = struct.unpack("<i", rec[36:40])[0]
-            pts = np.frombuffer(rec, "<f8", npts * 2, 40).reshape(-1, 2)
-            b.add(GeometryType.MULTIPOINT, [[p[None]] for p in pts])
-        elif st in _SHP_LINE or st in _SHP_POLY:
-            nparts, npts = struct.unpack("<2i", rec[36:44])
-            parts = np.frombuffer(rec, "<i4", nparts, 44)
-            pts = np.frombuffer(rec, "<f8", npts * 2,
-                                44 + 4 * nparts).reshape(-1, 2)
-            ends = np.append(parts[1:], npts)
-            rings = [pts[s:e].copy() for s, e in zip(parts, ends)]
-            if st in _SHP_LINE:
-                if len(rings) == 1:
-                    b.add_linestring(rings[0])
+        try:
+            with decode_guard(path=base + ".shp",
+                              feature=f"record {n - 1}",
+                              offset=rec_off):
+                st = struct.unpack("<i", rec[:4])[0]
+                if st == _SHP_NULL:
+                    b.add(GeometryType.GEOMETRYCOLLECTION, [])
+                elif st in _SHP_POINT:
+                    x, y = struct.unpack("<2d", rec[4:20])
+                    b.add_point(np.array([x, y]))
+                elif st in _SHP_MPOINT:
+                    npts = struct.unpack("<i", rec[36:40])[0]
+                    pts = np.frombuffer(rec, "<f8", npts * 2,
+                                        40).reshape(-1, 2)
+                    b.add(GeometryType.MULTIPOINT,
+                          [[p[None]] for p in pts])
+                elif st in _SHP_LINE or st in _SHP_POLY:
+                    nparts, npts = struct.unpack("<2i", rec[36:44])
+                    parts = np.frombuffer(rec, "<i4", nparts, 44)
+                    pts = np.frombuffer(rec, "<f8", npts * 2,
+                                        44 + 4 * nparts).reshape(-1, 2)
+                    ends = np.append(parts[1:], npts)
+                    rings = [pts[s:e].copy()
+                             for s, e in zip(parts, ends)]
+                    if st in _SHP_LINE:
+                        if len(rings) == 1:
+                            b.add_linestring(rings[0])
+                        else:
+                            b.add(GeometryType.MULTILINESTRING,
+                                  [[r] for r in rings])
+                    else:
+                        _add_shp_polygon(b, rings)
                 else:
-                    b.add(GeometryType.MULTILINESTRING,
-                          [[r] for r in rings])
+                    raise ValueError(f"unsupported shape type {st}")
+        except ValueError as e:
+            sink.handle(e)
+            if sink.on_error == "null":
+                # keep the attribute row aligned with a placeholder
+                b.add(GeometryType.GEOMETRYCOLLECTION, [])
             else:
-                _add_shp_polygon(b, rings)
-        else:
-            raise ValueError(f"unsupported shape type {st}")
+                dropped.add(n - 1)
     geoms = b.finish()
 
     cols: Dict[str, list] = {}
     if os.path.exists(base + ".dbf"):
-        cols = _read_dbf(base + ".dbf")
+        cols = _read_dbf(base + ".dbf", sink=sink)
         counts = {k: len(v) for k, v in cols.items()}
-        if counts and any(c != len(geoms) for c in counts.values()):
+        if counts and any(c != n for c in counts.values()):
             raise ValueError(
-                f"{base}.dbf row count {counts} != {len(geoms)} shapes")
+                f"{base}.dbf row count {counts} != {n} shapes")
+        if dropped:
+            cols = {k: [v for i, v in enumerate(vals)
+                        if i not in dropped]
+                    for k, vals in cols.items()}
+    sink.export(errors)
     return geoms, cols
 
 
@@ -197,7 +233,8 @@ def _add_shp_polygon(b: GeometryBuilder, rings: List[np.ndarray]):
               [[o, *hs] for o, hs in zip(outers, assigned)])
 
 
-def _read_dbf(path: str) -> Dict[str, list]:
+def _read_dbf(path: str,
+              sink: Optional[ErrorSink] = None) -> Dict[str, list]:
     with open(path, "rb") as f:
         buf = f.read()
     nrec, hsize, rsize = struct.unpack("<IHH", buf[4:12])
@@ -213,10 +250,11 @@ def _read_dbf(path: str) -> Dict[str, list]:
     cols: Dict[str, list] = {f[0]: [] for f in fields}
     deleted = []
     off = hsize
-    for _ in range(nrec):
+    for ri in range(nrec):
         if off + rsize > len(buf):
             break
         rec = buf[off:off + rsize]
+        rec_off = off
         off += rsize
         # soft-deleted rows are kept (row i must stay aligned with .shp
         # record i) but surfaced so callers can filter
@@ -226,17 +264,28 @@ def _read_dbf(path: str) -> Dict[str, list]:
             raw = rec[p:p + flen]
             p += flen
             s = raw.decode("latin-1").strip()
-            if ftype in ("N", "F"):
-                if not s:
-                    cols[name].append(None)
-                elif fdec or ftype == "F" or "." in s:
-                    cols[name].append(float(s))
-                else:
-                    cols[name].append(int(s))
-            elif ftype == "L":
-                cols[name].append(s.upper() in ("T", "Y"))
-            else:
-                cols[name].append(s)
+            try:
+                with decode_guard(path=path,
+                                  feature=f"record {ri} field {name}",
+                                  offset=rec_off):
+                    if ftype in ("N", "F"):
+                        if not s:
+                            cols[name].append(None)
+                        elif fdec or ftype == "F" or "." in s:
+                            cols[name].append(float(s))
+                        else:
+                            cols[name].append(int(s))
+                    elif ftype == "L":
+                        cols[name].append(s.upper() in ("T", "Y"))
+                    else:
+                        cols[name].append(s)
+            except ValueError as e:
+                if sink is None:
+                    raise
+                # an unparseable field degrades to a null cell; the
+                # row (and its geometry) survives
+                sink.handle(e)
+                cols[name].append(None)
     if any(deleted):
         cols["_deleted"] = deleted
     return cols
@@ -374,11 +423,15 @@ def _write_dbf(path: str, nrows: int, columns: Dict[str, list]) -> None:
 
 # ------------------------------------------------------- driver dispatch
 
-def read_vector(path: str, driver: Optional[str] = None
+def read_vector(path: str, driver: Optional[str] = None,
+                on_error: Optional[str] = None,
+                errors: Optional[list] = None
                 ) -> Tuple[GeometryArray, Dict[str, list]]:
     """OGR-style entry point: driver by name or file extension
     (reference: OGRFileFormat.scala driver dispatch + the preset
-    wrappers ShapefileFileFormat/GeoDBFileFormat)."""
+    wrappers ShapefileFileFormat/GeoDBFileFormat).  ``on_error`` /
+    ``errors`` thread the degrade-not-die policy through the drivers
+    that support it (shapefile, gpkg)."""
     drv = (driver or "").lower()
     if not drv:
         ext = os.path.splitext(path)[1].lower()
@@ -386,10 +439,10 @@ def read_vector(path: str, driver: Optional[str] = None
                ".geojson": "geojson", ".wkt": "wkt",
                ".gpkg": "gpkg"}.get(ext, "")
     if drv in ("esri shapefile", "shapefile", "shp"):
-        return read_shapefile(path)
+        return read_shapefile(path, on_error=on_error, errors=errors)
     if drv in ("gpkg", "geopackage"):
         from .geopackage import read_gpkg
-        return read_gpkg(path)
+        return read_gpkg(path, on_error=on_error, errors=errors)
     if drv == "geojson":
         import json
         from ..core.geometry.geojson import read_geojson

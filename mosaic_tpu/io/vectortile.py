@@ -23,6 +23,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.geometry.array import GeometryArray, GeometryType
+from ..resilience import faults
+from ..resilience.ingest import ErrorSink, decode_guard
 
 __all__ = ["tile_envelope_4326", "st_asmvttileagg",
            "st_asgeojsontileagg", "decode_mvt"]
@@ -302,9 +304,21 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
         shift += 7
 
 
-def decode_mvt(blob: bytes) -> dict:
+def decode_mvt(blob: bytes, on_error: Optional[str] = None,
+               path: Optional[str] = None,
+               errors: Optional[list] = None) -> dict:
     """Minimal MVT decoder: {layer: {extent, features: [{id, type,
-    geometry(commands decoded to rings), tags}] , keys, values}}."""
+    geometry(commands decoded to rings), tags}] , keys, values}}.
+
+    ``on_error`` (default: ``MosaicConfig.io_on_error``) governs
+    malformed features: ``"raise"`` fails fast with a located
+    ``CodecError``; ``"skip"``/``"null"`` drop the damaged feature,
+    keep the rest of the layer, and append ErrorRecords to ``errors``
+    when a list is supplied.  Damage outside a feature body (layer
+    framing) always raises."""
+    faults.maybe_fail("mvt.decode")
+    sink = ErrorSink(on_error, driver="mvt", path=path)
+
     def parse_msg(buf):
         i = 0
         fields = []
@@ -332,12 +346,16 @@ def decode_mvt(blob: bytes) -> dict:
         return (v >> 1) ^ -(v & 1)
 
     out = {}
-    for num, payload in parse_msg(blob):
+    with decode_guard(path=path, feature="tile"):
+        top = parse_msg(blob)
+    for num, payload in top:
         if num != 3:
             continue
         layer = {"features": [], "keys": [], "values": [],
                  "extent": _EXTENT, "name": None, "version": None}
-        for fn, fv in parse_msg(payload):
+        with decode_guard(path=path, feature="layer"):
+            layer_fields = parse_msg(payload)
+        for fn, fv in layer_fields:
             if fn == 1:
                 layer["name"] = fv.decode()
             elif fn == 15:
@@ -360,54 +378,64 @@ def decode_mvt(blob: bytes) -> dict:
                 else:
                     layer["values"].append(vf[1])
             elif fn == 2:
+                fv = faults.corrupt("mvt.decode_feature", fv)
                 feat = {"id": None, "type": None, "tags": [],
                         "rings": []}
-                for gn, gv in parse_msg(fv):
-                    if gn == 1:
-                        feat["id"] = gv
-                    elif gn == 3:
-                        feat["type"] = gv
-                    elif gn == 2:
-                        i = 0
-                        while i < len(gv):
-                            v, i = _read_varint(gv, i)
-                            feat["tags"].append(v)
-                    elif gn == 4:
-                        cmds = []
-                        i = 0
-                        while i < len(gv):
-                            v, i = _read_varint(gv, i)
-                            cmds.append(v)
-                        # decode command stream to rings
-                        rings = []
-                        cur = []
-                        cx = cy = 0
-                        j = 0
-                        while j < len(cmds):
-                            cid = cmds[j] & 0x7
-                            cnt = cmds[j] >> 3
-                            j += 1
-                            if cid == 1:
+                fi = len(layer["features"])
+                try:
+                    with decode_guard(path=path,
+                                      feature=f"feature {fi}"):
+                        faults.maybe_fail("mvt.decode_feature")
+                        for gn, gv in parse_msg(fv):
+                            if gn == 1:
+                                feat["id"] = gv
+                            elif gn == 3:
+                                feat["type"] = gv
+                            elif gn == 2:
+                                i = 0
+                                while i < len(gv):
+                                    v, i = _read_varint(gv, i)
+                                    feat["tags"].append(v)
+                            elif gn == 4:
+                                cmds = []
+                                i = 0
+                                while i < len(gv):
+                                    v, i = _read_varint(gv, i)
+                                    cmds.append(v)
+                                # decode command stream to rings
+                                rings = []
+                                cur = []
+                                cx = cy = 0
+                                j = 0
+                                while j < len(cmds):
+                                    cid = cmds[j] & 0x7
+                                    cnt = cmds[j] >> 3
+                                    j += 1
+                                    if cid == 1:
+                                        if cur:
+                                            rings.append(np.array(cur))
+                                            cur = []
+                                        for _ in range(cnt):
+                                            cx += unzig(cmds[j])
+                                            cy += unzig(cmds[j + 1])
+                                            j += 2
+                                            cur.append((cx, cy))
+                                    elif cid == 2:
+                                        for _ in range(cnt):
+                                            cx += unzig(cmds[j])
+                                            cy += unzig(cmds[j + 1])
+                                            j += 2
+                                            cur.append((cx, cy))
+                                    elif cid == 7:
+                                        rings.append(np.array(cur))
+                                        cur = []
                                 if cur:
                                     rings.append(np.array(cur))
-                                    cur = []
-                                for _ in range(cnt):
-                                    cx += unzig(cmds[j])
-                                    cy += unzig(cmds[j + 1])
-                                    j += 2
-                                    cur.append((cx, cy))
-                            elif cid == 2:
-                                for _ in range(cnt):
-                                    cx += unzig(cmds[j])
-                                    cy += unzig(cmds[j + 1])
-                                    j += 2
-                                    cur.append((cx, cy))
-                            elif cid == 7:
-                                rings.append(np.array(cur))
-                                cur = []
-                        if cur:
-                            rings.append(np.array(cur))
-                        feat["rings"] = rings
+                                feat["rings"] = rings
+                except ValueError as e:
+                    sink.handle(e)
+                    continue
                 layer["features"].append(feat)
         out[layer["name"]] = layer
+    sink.export(errors)
     return out

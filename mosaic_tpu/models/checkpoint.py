@@ -12,10 +12,14 @@ from __future__ import annotations
 
 import os
 import tempfile
+import zipfile
 from typing import Optional
 
 import numpy as np
 
+from ..obs import metrics
+from ..resilience import faults
+from ..resilience.retry import CHECKPOINT_RETRY
 from .core import IterationState
 
 
@@ -39,15 +43,19 @@ class CheckpointManager:
         arrays = {k: np.asarray(v) for k, v in state.payload.items()}
         arrays["__iteration"] = np.int64(state.iteration)
         arrays["__converged"] = np.bool_(state.converged)
-        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
-        os.close(fd)
-        try:
-            with open(tmp, "wb") as f:
-                np.savez(f, **arrays)
-            os.replace(tmp, self._file(state.iteration))
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+
+        def _write():
+            faults.maybe_fail("checkpoint.model_write")
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+            os.close(fd)
+            try:
+                with open(tmp, "wb") as f:
+                    np.savez(f, **arrays)
+                os.replace(tmp, self._file(state.iteration))
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        CHECKPOINT_RETRY.call(_write)
         self._gc()
         return self._file(state.iteration)
 
@@ -66,13 +74,26 @@ class CheckpointManager:
             os.unlink(self._file(it))
 
     def load_latest(self) -> Optional[IterationState]:
-        its = self._iterations()
-        if not its:
-            return None
-        with np.load(self._file(its[-1])) as z:
-            payload = {k: z[k] for k in z.files
-                       if not k.startswith("__")}
-            return IterationState(
-                iteration=int(z["__iteration"]),
-                payload=payload,
-                converged=bool(z["__converged"]))
+        """Newest complete state, falling back through older
+        checkpoints when the latest is unreadable (a torn npz from a
+        crashed writer must not strand the resume — degrade to the
+        previous iteration instead)."""
+        last_err: Optional[BaseException] = None
+        for it in reversed(self._iterations()):
+            try:
+                faults.maybe_fail("checkpoint.model_read")
+                with np.load(self._file(it)) as z:
+                    payload = {k: z[k] for k in z.files
+                               if not k.startswith("__")}
+                    return IterationState(
+                        iteration=int(z["__iteration"]),
+                        payload=payload,
+                        converged=bool(z["__converged"]))
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile
+                    ) as e:
+                last_err = e
+                metrics.count("checkpoint/unreadable")
+                continue
+        if last_err is not None:
+            raise last_err
+        return None

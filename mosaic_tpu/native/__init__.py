@@ -18,6 +18,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..resilience import faults
+from ..resilience.retry import NATIVE_COMPILE_RETRY, NATIVE_LOAD_RETRY
+
 _LIB = None
 _TRIED = False
 
@@ -28,19 +31,27 @@ _TRIED = False
 _SWEEP_MAX_AGE_S = 86_400.0
 
 
-def _compile(src: str, lib_path: str) -> bool:
+def _compile_once(src: str, lib_path: str) -> None:
+    faults.maybe_fail("native.compile")
     tmp = lib_path + f".build.{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, lib_path)
-        return True
-    except (OSError, subprocess.SubprocessError):
+    except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
             pass
+        raise
+
+
+def _compile(src: str, lib_path: str) -> bool:
+    try:
+        NATIVE_COMPILE_RETRY.call(_compile_once, src, lib_path)
+        return True
+    except (OSError, subprocess.SubprocessError):
         return False
 
 
@@ -75,22 +86,25 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 pass
         if not _compile(src, lib_path):
             return None
-    try:
-        lib = ctypes.CDLL(lib_path)
-    except OSError:
+    def _load():
+        faults.maybe_fail("native.cdll")
+        return ctypes.CDLL(lib_path)
+
+    def _rebuild(exc, attempt):
         # our .so existed but would not load (e.g. another checkout's
         # sweep unlinked it after our existence check, or a truncated
-        # build survived): rebuild once before giving up
+        # build survived): rebuild before the re-attempt; if the
+        # rebuild also fails the retry's CDLL raises and we give up
         try:
             os.unlink(lib_path)
         except OSError:
             pass
-        if not _compile(src, lib_path):
-            return None
-        try:
-            lib = ctypes.CDLL(lib_path)
-        except OSError:
-            return None
+        _compile(src, lib_path)
+
+    try:
+        lib = NATIVE_LOAD_RETRY.call(_load, on_retry=_rebuild)
+    except OSError:
+        return None
     lib.pip_first_match.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]

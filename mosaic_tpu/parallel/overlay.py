@@ -38,6 +38,7 @@ import numpy as np
 from ..core.geometry.array import GeometryArray
 from ..core.index.base import IndexSystem
 from ..core.tessellate import tessellate
+from ..resilience import faults
 from ..types import ChipSet
 
 EPS_DEG = 1e-6
@@ -519,13 +520,16 @@ def overlay_row_pairs(chips_a, chips_b, polys_a: GeometryArray,
             ext = max(ext, float(np.abs(fin).max()))
     eps = max(EPS_DEG, 64.0 * float(np.spacing(np.float32(ext))))
 
-    dup_cap = _exact_dup_cap(ca, va, cb, vb)
+    dup_cap = faults.degrade("overlay.dup_cap",
+                             _exact_dup_cap(ca, va, cb, vb))
     if mesh is not None:
         D = mesh.shape[axis]
         rpa = -(-len(ca) // D)
         rpb = -(-len(cb) // D)
-        bucket_cap = max(_exact_bucket_cap(ca, va, D),
-                         _exact_bucket_cap(cb, vb, D))
+        bucket_cap = faults.degrade(
+            "overlay.bucket_cap",
+            max(_exact_bucket_cap(ca, va, D),
+                _exact_bucket_cap(cb, vb, D)))
         ca, rowa, ea, va = _pad_rows(ca, rowa, ea, va, rpa, D)
         cb, rowb, eb, vb = _pad_rows(cb, rowb, eb, vb, rpb, D)
         pair_cap = max(1024, 4 * max(rpa, rpb))
@@ -667,15 +671,20 @@ def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
             ext = max(ext, float(np.abs(fin).max()))
     eps = max(EPS_DEG, 64.0 * float(np.spacing(np.float32(ext))))
 
-    dup_cap = _exact_dup_cap(ca, va, cb, vb)
+    dup_cap = faults.degrade("overlay.dup_cap",
+                             _exact_dup_cap(ca, va, cb, vb))
     if mesh is not None:
         D = mesh.shape[axis]
         rpa = -(-len(ca) // D)
         rpb = -(-len(cb) // D)
         # size the exchange exactly from the host-computed hash — no
         # overflow retry/recompile is possible for buckets or dups
-        bucket_cap = max(_exact_bucket_cap(ca, va, D),
-                         _exact_bucket_cap(cb, vb, D))
+        # (unless a chaos plan degrades the capacity on purpose, which
+        # exercises the overflow-retry loop below)
+        bucket_cap = faults.degrade(
+            "overlay.bucket_cap",
+            max(_exact_bucket_cap(ca, va, D),
+                _exact_bucket_cap(cb, vb, D)))
         ca, gea, ea, va = _pad_rows(ca, gea, ea, va, rpa, D)
         cb, geb, eb, vb = _pad_rows(cb, geb, eb, vb, rpb, D)
     args = tuple(jnp.asarray(v) for v in

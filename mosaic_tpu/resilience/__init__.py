@@ -1,0 +1,36 @@
+"""Resilience layer: fault injection, retry/backoff, degrade-not-die.
+
+The reference Mosaic inherits Spark's task retry and per-record error
+semantics; our TPU-native stack supplies the equivalent explicitly:
+
+* ``resilience.faults`` — deterministic, seedable fault plans armed via
+  ``MOSAIC_TPU_FAULT_PLAN`` or :func:`faults.arm`, consulted by cheap
+  probes (``maybe_fail`` / ``corrupt`` / ``degrade``) placed at named
+  sites across io / raster / native / parallel;
+* ``resilience.retry`` — declarative :class:`RetryPolicy` (attempt
+  budget, exponential backoff, deterministic jitter, exception
+  allowlist, obs counters) applied to checkpoint IO and native
+  compile/load;
+* ``resilience.ingest`` — ``on_error="raise"|"skip"|"null"`` policy for
+  every codec: malformed records become structured
+  :class:`ErrorRecord`\\ s plus ``io/records_dropped`` metrics instead
+  of process-killing exceptions;
+* ``resilience.testing`` — the ``fault_plan`` pytest fixture.
+
+See docs/usage/resilience.md.
+"""
+
+from . import faults
+from .faults import FaultPlan, FaultRule, InjectedFault
+from .ingest import (ON_ERROR_MODES, CodecError, ErrorRecord, ErrorSink,
+                     decode_guard)
+from .retry import (CHECKPOINT_RETRY, NATIVE_COMPILE_RETRY,
+                    NATIVE_LOAD_RETRY, RetryPolicy, retrying)
+
+__all__ = [
+    "faults", "FaultPlan", "FaultRule", "InjectedFault",
+    "RetryPolicy", "retrying", "CHECKPOINT_RETRY",
+    "NATIVE_COMPILE_RETRY", "NATIVE_LOAD_RETRY",
+    "CodecError", "ErrorRecord", "ErrorSink", "decode_guard",
+    "ON_ERROR_MODES",
+]

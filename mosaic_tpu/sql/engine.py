@@ -185,7 +185,24 @@ class SQLSession:
         """Run a query.  ``EXPLAIN ANALYZE SELECT ...`` executes the
         query and returns the per-operator profile instead of the
         result (operator, detail, rows out, wall ms); bare ``EXPLAIN``
-        returns the plan without executing."""
+        returns the plan without executing.  ``SET mosaic.key = value``
+        updates the session-default :class:`MosaicConfig` through the
+        validated conf path (reference: ``spark.conf.set`` on the
+        mosaic.* namespace) and returns the applied pair."""
+        import re as _re
+        m = _re.match(r"\s*SET\s+([A-Za-z][\w.]*)\s*=\s*(.+?)\s*;?\s*$",
+                      query, _re.IGNORECASE)
+        if m:
+            key, raw = m.group(1), m.group(2)
+            value = raw.strip("'\"")
+            from .. import config as _config
+            try:
+                cfg = _config.apply_conf(
+                    _config.default_config(), key, value)
+            except _config.ConfigError as e:
+                raise SQLError(str(e)) from e
+            _config.set_default_config(cfg)
+            return Table({"key": [key], "value": [value]})
         q = parse(query)
         if q.explain == "plan":
             ops = self._plan_ops(q)

@@ -29,6 +29,9 @@ assert jax.device_count() == 8, jax.devices()
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from mosaic_tpu.resilience.testing import (fault_plan,  # noqa: E402,F401
+                                           no_faults)
+
 
 @pytest.fixture(scope="session")
 def rng():

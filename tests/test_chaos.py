@@ -1,0 +1,348 @@
+"""Chaos tests: seeded fault plans driven through real code paths.
+
+Every test arms a deterministic :class:`FaultPlan` (same seed -> same
+injections) and asserts the resilience contract end to end: skip-mode
+recovers every intact record with counts asserted, retried checkpoint /
+native ops succeed after transient injected failures, and raise-mode on
+clean inputs matches the undamaged decode byte for byte.
+"""
+
+import dataclasses
+import os
+import shutil
+import struct
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.core.raster.gtiff import read_gtiff, write_gtiff
+from mosaic_tpu.core.raster.tile import GeoTransform, RasterTile
+from mosaic_tpu.resilience import faults
+
+GRIB_FIX = os.path.join(os.path.dirname(__file__), "data",
+                        "cams_sample.grb")
+SHP_FIX = os.path.join(os.path.dirname(__file__), "data",
+                       "nyc_taxi_zones_2263.shp")
+
+
+def _tile(bands=1, h=8, w=512, nodata=None):
+    """Striped GeoTIFF fixture: w*8 bytes/row -> 2 rows/strip -> 4
+    strips, so one damaged strip leaves the rest intact."""
+    data = np.arange(bands * h * w, dtype=np.float64).reshape(
+        bands, h, w) + 1.0
+    gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+    return RasterTile(data, gt, nodata=nodata)
+
+
+# ------------------------------------------------------------- gtiff
+
+def test_gtiff_strip_corruption_skip_recovers_rest(fault_plan):
+    tile = _tile()
+    blob = write_gtiff(tile)
+    clean = read_gtiff(blob)
+    plan = fault_plan(
+        "seed=21;site=gtiff.read_strip,fails=1,mode=truncate")
+    out = read_gtiff(blob, on_error="skip", path="t.tif")
+    assert [s for s, _, _ in plan.injected] == ["gtiff.read_strip"]
+    recs = out.meta["decode_errors"]
+    assert len(recs) == 1
+    assert recs[0]["feature"] == "strip 0"
+    assert recs[0]["path"] == "t.tif"
+    # strip 0 = rows 0..1 zeroed; every other row byte-identical
+    got = np.asarray(out.data)
+    want = np.asarray(clean.data)
+    assert np.array_equal(got[:, 2:], want[:, 2:])
+    assert np.all(got[:, :2] == 0.0)
+
+
+def test_gtiff_strip_corruption_null_fills_nodata(fault_plan):
+    blob_nan = write_gtiff(_tile())
+    blob_nd = write_gtiff(_tile(nodata=-9999.0))
+    fault_plan("seed=21;site=gtiff.read_strip,fails=1,mode=truncate")
+    out = read_gtiff(blob_nan, on_error="null")
+    assert np.all(np.isnan(np.asarray(out.data)[:, :2]))
+    fault_plan("seed=21;site=gtiff.read_strip,fails=1,mode=truncate")
+    out = read_gtiff(blob_nd, on_error="null")
+    assert np.all(np.asarray(out.data)[:, :2] == -9999.0)
+
+
+def test_gtiff_strip_corruption_raise_mode_locates(fault_plan):
+    blob = write_gtiff(_tile())
+    fault_plan("seed=21;site=gtiff.read_strip,fails=1,mode=truncate")
+    with pytest.raises(ValueError, match="strip 0"):
+        read_gtiff(blob)                  # default on_error="raise"
+
+
+def test_gtiff_clean_input_parity_across_modes(no_faults):
+    tile = _tile(bands=2, h=6, w=256)
+    blob = write_gtiff(tile)
+    want = np.asarray(read_gtiff(blob).data)
+    for mode in ("raise", "skip", "null"):
+        out = read_gtiff(blob, on_error=mode)
+        assert np.array_equal(np.asarray(out.data), want)
+        assert "decode_errors" not in out.meta
+
+
+# -------------------------------------------------------------- grib
+
+@pytest.fixture(scope="module")
+def grib_bytes():
+    with open(GRIB_FIX, "rb") as f:
+        return f.read()
+
+
+def test_grib_injected_message_failure_skip(fault_plan, grib_bytes):
+    from mosaic_tpu.io.grib import read_grib
+    clean = read_grib(grib_bytes)
+    plan = fault_plan(
+        "seed=22;site=grib.read_message,fails=1,error=ValueError")
+    errs = []
+    out = read_grib(grib_bytes, on_error="skip", path="cams.grb",
+                    errors=errs)
+    assert len(plan.injected) == 1
+    assert len(errs) == 1
+    assert errs[0].feature == "message 0"
+    assert errs[0].path == "cams.grb"
+    # every message except the damaged one decodes identically
+    assert set(out) < set(clean)
+    for name in out:
+        np.testing.assert_array_equal(out[name].data, clean[name].data)
+    lost = set(clean) - set(out)
+    assert lost and all(n.endswith("_0") or "_0_" in n for n in lost)
+
+
+def test_grib_injected_message_failure_raise(fault_plan, grib_bytes):
+    from mosaic_tpu.io.grib import read_grib
+    fault_plan("seed=22;site=grib.read_message,fails=1,error=ValueError")
+    with pytest.raises(ValueError, match="message 0"):
+        read_grib(grib_bytes)
+
+
+# --------------------------------------------------------- shapefile
+
+def test_shapefile_record_corruption_skip_drops_row(fault_plan,
+                                                    tmp_path):
+    from mosaic_tpu.io.shapefile import read_shapefile
+    clean_geoms, clean_cols = read_shapefile(SHP_FIX)
+    n = len(clean_geoms)
+    plan = fault_plan(
+        "seed=23;site=shapefile.read_record,fails=1,mode=truncate")
+    errs = []
+    geoms, cols = read_shapefile(SHP_FIX, on_error="skip", errors=errs)
+    assert len(plan.injected) == 1
+    assert len(errs) == 1 and errs[0].feature == "record 0"
+    assert len(geoms) == n - 1
+    for k, v in cols.items():
+        assert len(v) == n - 1                 # dbf row dropped too
+        assert v == clean_cols[k][1:]
+
+
+def test_shapefile_record_corruption_null_keeps_alignment(fault_plan):
+    from mosaic_tpu.core.geometry.array import GeometryType
+    from mosaic_tpu.io.shapefile import read_shapefile
+    clean_geoms, clean_cols = read_shapefile(SHP_FIX)
+    n = len(clean_geoms)
+    fault_plan(
+        "seed=23;site=shapefile.read_record,fails=1,mode=truncate")
+    geoms, cols = read_shapefile(SHP_FIX, on_error="null")
+    assert len(geoms) == n
+    assert geoms.geom_type(0) == GeometryType.GEOMETRYCOLLECTION
+    for k, v in cols.items():
+        assert v == clean_cols[k]              # all rows kept
+
+
+def test_dbf_bad_numeric_degrades_to_null(tmp_path):
+    from mosaic_tpu.core.geometry.array import GeometryBuilder
+    from mosaic_tpu.io.shapefile import read_shapefile, write_shapefile
+    b = GeometryBuilder()
+    for x in (0.0, 2.0, 4.0):
+        b.add_point(np.array([x, 0.0]))
+    base = str(tmp_path / "pts")
+    write_shapefile(base, b.finish(), {"val": [1, 2, 3]})
+    with open(base + ".dbf", "rb") as f:
+        buf = f.read()
+    patched = buf.replace(b" " * 17 + b"2", b" " * 17 + b"x")
+    assert patched != buf
+    with open(base + ".dbf", "wb") as f:
+        f.write(patched)
+    with pytest.raises(ValueError, match="field val"):
+        read_shapefile(base)
+    errs = []
+    geoms, cols = read_shapefile(base, on_error="skip", errors=errs)
+    assert len(geoms) == 3                     # geometry row survives
+    assert cols["val"] == [1, None, 3]
+    assert len(errs) == 1 and "field val" in errs[0].feature
+
+
+# ------------------------------------------------------------- netcdf
+
+def test_netcdf_truncated_variable_skip(fault_plan):
+    from mosaic_tpu.io.netcdf import read_netcdf, write_netcdf
+    a = np.arange(12.0).reshape(3, 4)
+    blob = write_netcdf({"aa": a, "zz": a * 2})
+    clean = read_netcdf(blob)
+    assert set(clean) == {"aa", "zz"}
+    damaged = blob[:-16]          # tail = end of the last variable (zz)
+    with pytest.raises(ValueError, match="variable zz"):
+        read_netcdf(damaged, path="t.nc")
+    errs = []
+    out = read_netcdf(damaged, on_error="skip", errors=errs)
+    assert set(out) == {"aa"}
+    np.testing.assert_array_equal(out["aa"].data, clean["aa"].data)
+    assert len(errs) == 1 and errs[0].feature == "variable zz"
+
+
+# -------------------------------------------------------------- gpkg
+
+def test_gpkg_malformed_blob_skip(tmp_path):
+    import sqlite3
+
+    from mosaic_tpu.core.geometry.array import GeometryBuilder
+    from mosaic_tpu.io.geopackage import read_gpkg, write_gpkg
+    b = GeometryBuilder()
+    for x in (0.0, 1.0, 2.0):
+        ring = np.array([[x, 0.0], [x + 0.5, 0.0], [x + 0.5, 0.5],
+                         [x, 0.5], [x, 0.0]])
+        b.add_polygon(ring)
+    path = str(tmp_path / "t.gpkg")
+    write_gpkg(path, b.finish(), {"fid_val": [10, 20, 30]})
+    con = sqlite3.connect(path)
+    con.execute("UPDATE layer SET geom = X'DEADBEEF' WHERE rowid = 2")
+    con.commit()
+    con.close()
+    with pytest.raises(ValueError, match="row 1"):
+        read_gpkg(path)
+    errs = []
+    geoms, cols = read_gpkg(path, on_error="skip", errors=errs)
+    assert len(geoms) == 2
+    assert cols["fid_val"] == [10, 30]
+    assert len(errs) == 1 and errs[0].feature == "row 1"
+
+
+# ---------------------------------------------------------------- mvt
+
+def test_mvt_injected_feature_failures_skip(fault_plan):
+    from mosaic_tpu.core.geometry.array import GeometryBuilder
+    from mosaic_tpu.io.vectortile import decode_mvt, st_asmvttileagg
+    b = GeometryBuilder()
+    for x in (-0.4, 0.2, 0.4):
+        ring = np.array([[x, 0.1], [x + 0.1, 0.1], [x + 0.1, 0.2],
+                         [x, 0.2], [x, 0.1]])
+        b.add_polygon(ring)
+    blob = st_asmvttileagg(b.finish(), {"v": [1, 2, 3]}, 0, 0, 0)
+    clean = decode_mvt(blob)["layer"]
+    nfeat = len(clean["features"])
+    assert nfeat == 3
+    plan = fault_plan(
+        "seed=27;site=mvt.decode_feature,rate=0.5,error=ValueError")
+    errs = []
+    out = decode_mvt(blob, on_error="skip", errors=errs)["layer"]
+    assert len(out["features"]) + len(errs) == nfeat
+    assert 1 <= len(errs) <= nfeat
+    assert len(errs) == len([1 for s, _, _ in plan.injected
+                             if s == "mvt.decode_feature"])
+
+
+# ------------------------------------------------- checkpoint retries
+
+def test_raster_checkpoint_rides_out_transient_io(fault_plan, tmp_path):
+    from mosaic_tpu.core.raster.checkpoint import (deserialize_tile,
+                                                   serialize_tile)
+    cfg = dataclasses.replace(_config.default_config(),
+                              raster_use_checkpoint=True,
+                              raster_checkpoint=str(tmp_path))
+    tile = _tile(h=4, w=64)
+    plan = fault_plan("seed=31;site=checkpoint.write,fails=2;"
+                      "site=checkpoint.read,fails=2")
+    rec = serialize_tile(tile, cfg)
+    assert isinstance(rec["raster"], str)      # path mode
+    out = deserialize_tile(rec)
+    np.testing.assert_array_equal(np.asarray(out.data),
+                                  np.asarray(tile.data))
+    sites = [s for s, _, _ in plan.injected]
+    assert sites.count("checkpoint.write") == 2
+    assert sites.count("checkpoint.read") == 2
+
+
+def test_model_checkpoint_save_retry_and_torn_latest(fault_plan,
+                                                     tmp_path):
+    from mosaic_tpu.models.checkpoint import CheckpointManager
+    from mosaic_tpu.models.core import IterationState
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    plan = fault_plan("seed=32;site=checkpoint.model_write,fails=2")
+    mgr.save(IterationState(iteration=1,
+                            payload={"x": np.arange(3.0)}))
+    assert len(plan.injected) == 2             # retried through
+    fault_plan("seed=32")                      # no rules: clean writes
+    mgr.save(IterationState(iteration=2,
+                            payload={"x": np.arange(4.0)}))
+    # tear the newest checkpoint: resume must degrade to iteration 1
+    with open(mgr._file(2), "wb") as f:
+        f.write(b"this is not an npz archive")
+    got = mgr.load_latest()
+    assert got is not None and got.iteration == 1
+    np.testing.assert_array_equal(got.payload["x"], np.arange(3.0))
+
+
+# ----------------------------------------------------- native rebuild
+
+def test_native_cdll_lost_library_rebuild(fault_plan):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain in this environment")
+    if os.environ.get("MOSAIC_TPU_DISABLE_NATIVE"):
+        pytest.skip("native layer disabled via env")
+    import mosaic_tpu.native as native
+    prev_lib, prev_tried = native._LIB, native._TRIED
+    try:
+        native._LIB, native._TRIED = None, False
+        plan = fault_plan("seed=33;site=native.cdll,fails=1")
+        lib = native.get_lib()
+        assert lib is not None                 # rebuilt + reloaded
+        assert ("native.cdll", 0, "OSError") in plan.injected
+    finally:
+        native._LIB, native._TRIED = prev_lib, prev_tried
+
+
+def test_native_compile_transient_failure_recovers(fault_plan,
+                                                   tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain in this environment")
+    import mosaic_tpu.native as native
+    src = os.path.join(os.path.dirname(native.__file__),
+                       "geokernels.cpp")
+    lib_path = str(tmp_path / "geokernels-test.so")
+    plan = fault_plan("seed=34;site=native.compile,fails=1")
+    assert native._compile(src, lib_path) is True
+    assert os.path.exists(lib_path)
+    assert ("native.compile", 0, "OSError") in plan.injected
+
+
+# ------------------------------------------- overlay capacity degrade
+
+def test_overlay_survives_degraded_capacities(fault_plan):
+    from mosaic_tpu.core.geometry.array import GeometryBuilder
+    from mosaic_tpu.core.index.factory import get_index_system
+    from mosaic_tpu.parallel.overlay import (overlay_host_truth,
+                                             overlay_intersects)
+    rng = np.random.default_rng(7)
+    b = GeometryBuilder()
+    for _ in range(40):
+        cx = rng.uniform(-74.05, -73.90)
+        cy = rng.uniform(40.65, 40.80)
+        w = rng.uniform(2e-4, 2e-3)
+        h = rng.uniform(2e-4, 2e-3)
+        b.add_polygon(np.array([[cx - w, cy - h], [cx + w, cy - h],
+                                [cx + w, cy + h], [cx - w, cy + h],
+                                [cx - w, cy - h]]))
+    a = b.finish()
+    from mosaic_tpu.bench.workloads import nyc_zones
+    zones = nyc_zones(n_side=3, seed=2,
+                      bbox=(-74.05, 40.65, -73.90, 40.80))
+    plan = fault_plan(
+        "seed=35;site=overlay.*,mode=degrade,rate=1.0,factor=4")
+    got = overlay_intersects(a, zones, 9, get_index_system("H3"))
+    assert any(s.startswith("overlay.") for s, _, _ in plan.injected)
+    faults.disarm()
+    want = overlay_host_truth(a, zones)
+    assert np.array_equal(got, want)

@@ -1,0 +1,321 @@
+"""Resilience layer unit tests: fault plans, retry policies, the
+degrade-not-die ingestion primitives, and the config/SQL satellites."""
+
+import dataclasses
+import struct
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.obs import metrics
+from mosaic_tpu.resilience import faults
+from mosaic_tpu.resilience.faults import FaultPlan, InjectedFault
+from mosaic_tpu.resilience.ingest import (CodecError, ErrorSink,
+                                          decode_guard)
+from mosaic_tpu.resilience.retry import RetryPolicy
+
+
+# ------------------------------------------------------------ fault plans
+
+def test_spec_parsing():
+    plan = FaultPlan.from_spec(
+        "seed=7;site=checkpoint.*,rate=0.5,error=OSError;"
+        "site=native.compile,fails=1;"
+        "site=overlay.*,mode=degrade,rate=1.0,factor=8")
+    assert plan.seed == 7
+    assert len(plan.rules) == 3
+    assert plan.rules[0].pattern == "checkpoint.*"
+    assert plan.rules[0].rate == 0.5
+    assert plan.rules[1].fails == 1
+    assert plan.rules[2].mode == "degrade"
+    assert plan.rules[2].factor == 8
+
+
+@pytest.mark.parametrize("bad", [
+    "site=x,mode=explode",            # unknown mode
+    "site=x,error=Nope",              # unknown error type
+    "rate=0.5",                       # clause without site=
+    "site=x,whatever",                # item without key=value
+])
+def test_spec_rejects_bad_clauses(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.from_spec(bad)
+
+
+def test_fails_n_then_recovers(fault_plan):
+    plan = fault_plan("seed=1;site=x.y,fails=2")
+    for _ in range(2):
+        with pytest.raises(OSError) as ei:
+            faults.maybe_fail("x.y")
+        assert isinstance(ei.value, InjectedFault)
+    faults.maybe_fail("x.y")          # third call passes
+    assert [s for s, _, _ in plan.injected] == ["x.y", "x.y"]
+
+
+def test_rate_decisions_deterministic():
+    spec = "seed=3;site=s,rate=0.5,error=ValueError"
+    hits = []
+    for _ in range(2):
+        plan = FaultPlan.from_spec(spec)
+        h = []
+        for i in range(64):
+            try:
+                plan.maybe_fail("s")
+                h.append(False)
+            except ValueError:
+                h.append(True)
+        hits.append(h)
+    assert hits[0] == hits[1]
+    assert any(hits[0]) and not all(hits[0])
+
+
+def test_site_pattern_scoping(fault_plan):
+    fault_plan("seed=1;site=checkpoint.*,fails=1")
+    faults.maybe_fail("native.compile")          # unmatched site: no-op
+    with pytest.raises(OSError):
+        faults.maybe_fail("checkpoint.write")
+
+
+def test_corrupt_truncate_deterministic():
+    spec = "seed=5;site=c,rate=1.0,mode=truncate"
+    data = bytes(range(64))
+    out = [FaultPlan.from_spec(spec).corrupt("c", data)
+           for _ in range(2)]
+    assert out[0] == out[1]
+    assert len(out[0]) < len(data)
+
+
+def test_corrupt_flip_changes_one_byte(fault_plan):
+    plan = fault_plan("seed=5;site=c,rate=1.0,mode=flip")
+    data = bytes(range(64))
+    out = plan.corrupt("c", data)
+    assert len(out) == len(data)
+    assert sum(a != b for a, b in zip(out, data)) == 1
+
+
+def test_degrade_shrinks_capacity(fault_plan):
+    fault_plan("seed=2;site=overlay.*,mode=degrade,rate=1.0,factor=4")
+    assert faults.degrade("overlay.bucket_cap", 100) == 25
+    assert faults.degrade("overlay.dup_cap", 2) == 1    # floor of 1
+    assert faults.degrade("other.site", 100) == 100
+
+
+def test_disarmed_probes_are_noops(no_faults):
+    assert faults.active() is None
+    faults.maybe_fail("anything")
+    assert faults.corrupt("anything", b"abc") == b"abc"
+    assert faults.degrade("anything", 7) == 7
+
+
+# ----------------------------------------------------------- retry policy
+
+def test_retry_recovers_after_transient(fault_plan):
+    plan = fault_plan("seed=1;site=r.t,fails=2")
+    pol = RetryPolicy(name="t", max_attempts=3, base_delay_s=0.001,
+                      jitter=0.0, retry_on=(OSError,))
+    delays = []
+
+    def fn():
+        faults.maybe_fail("r.t")
+        return 42
+
+    assert pol.call(fn, sleep=delays.append) == 42
+    assert delays == [0.001, 0.002]   # exponential, jitter off
+    assert len(plan.injected) == 2
+
+
+def test_retry_gives_up_and_reraises(fault_plan):
+    fault_plan("seed=1;site=r.g,fails=9")
+    pol = RetryPolicy(name="g", max_attempts=3, base_delay_s=0.0,
+                      jitter=0.0)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        faults.maybe_fail("r.g")
+
+    with pytest.raises(OSError) as ei:
+        pol.call(fn, sleep=lambda d: None)
+    assert isinstance(ei.value, InjectedFault)
+    assert len(calls) == 3
+
+
+def test_retry_allowlist_passes_other_exceptions_through():
+    pol = RetryPolicy(name="a", max_attempts=5, retry_on=(OSError,))
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        pol.call(fn, sleep=lambda d: None)
+    assert len(calls) == 1            # no retries for unlisted types
+
+
+def test_retry_jitter_is_deterministic():
+    pol = RetryPolicy(name="j", base_delay_s=0.1, jitter=0.25)
+    assert pol.delay(1, seed=5) == pol.delay(1, seed=5)
+    lo, hi = 0.2 * 0.75, 0.2 * 1.25
+    assert lo <= pol.delay(1, seed=5) <= hi
+
+
+def test_retry_on_retry_hook_and_counters(fault_plan):
+    fault_plan("seed=1;site=r.h,fails=1")
+    pol = RetryPolicy(name="hooked", max_attempts=2, base_delay_s=0.0,
+                      jitter=0.0)
+    seen = []
+    metrics.enable()
+    try:
+        base_a = metrics.counter_value("retry/attempts/hooked")
+        base_r = metrics.counter_value("retry/recovered/hooked")
+
+        def fn():
+            faults.maybe_fail("r.h")
+            return "ok"
+
+        out = pol.call(fn, on_retry=lambda e, a: seen.append((e, a)),
+                       sleep=lambda d: None)
+        assert out == "ok"
+        assert len(seen) == 1 and seen[0][1] == 0
+        assert metrics.counter_value("retry/attempts/hooked") \
+            == base_a + 1
+        assert metrics.counter_value("retry/recovered/hooked") \
+            == base_r + 1
+    finally:
+        metrics.disable()
+
+
+# ------------------------------------------------- degrade-not-die sinks
+
+def test_decode_guard_locates_raw_errors():
+    with pytest.raises(ValueError) as ei:
+        with decode_guard(path="f.tif", feature="strip 3", offset=128):
+            struct.unpack(">i", b"\x00")
+    e = ei.value
+    assert isinstance(e, CodecError)
+    msg = str(e)
+    assert "f.tif" in msg and "strip 3" in msg
+    assert "byte offset 128" in msg and "error" in msg
+    rec = e.record()
+    assert rec.offset == 128 and rec.feature == "strip 3"
+
+
+def test_decode_guard_passes_codec_errors_through():
+    inner = CodecError("boom", path="a", feature="b", offset=1)
+    with pytest.raises(CodecError) as ei:
+        with decode_guard(path="other"):
+            raise inner
+    assert ei.value is inner
+
+
+def test_error_sink_raise_mode_reraises():
+    sink = ErrorSink("raise", driver="t")
+    with pytest.raises(ValueError):
+        sink.handle(ValueError("bad"))
+    assert sink.dropped() == 0
+
+
+def test_error_sink_skip_mode_records():
+    sink = ErrorSink("skip", driver="t", path="p.bin")
+    sink.handle(ValueError("bad"), feature="record 3", offset=9)
+    sink.handle(CodecError("worse", feature="record 5", offset=11))
+    assert sink.dropped() == 2
+    assert sink.records[0].path == "p.bin"
+    assert sink.records[0].feature == "record 3"
+    assert sink.records[1].path == "p.bin"     # backfilled from sink
+    out = []
+    sink.export(out)
+    assert len(out) == 2
+
+
+def test_error_sink_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="on_error"):
+        ErrorSink("explode")
+
+
+def test_error_sink_default_comes_from_config():
+    prev = _config.default_config()
+    try:
+        _config.set_default_config(
+            dataclasses.replace(prev, io_on_error="skip"))
+        assert ErrorSink().on_error == "skip"
+    finally:
+        _config.set_default_config(prev)
+    assert ErrorSink().on_error == "raise"
+
+
+# ------------------------------------------------------ config satellites
+
+def test_blocksize_error_names_key():
+    with pytest.raises(_config.ConfigError,
+                       match="mosaic.raster.blocksize"):
+        _config.MosaicConfig.from_confs(
+            {"mosaic.raster.blocksize": "not-an-int"})
+    with pytest.raises(_config.ConfigError,
+                       match="mosaic.raster.blocksize"):
+        _config.MosaicConfig.from_confs(
+            {"mosaic.raster.blocksize": "-4"})
+
+
+def test_device_dtype_and_exact_fallback_confs():
+    cfg = _config.MosaicConfig.from_confs({
+        "mosaic.device.dtype": "float64",
+        "mosaic.exact.fallback": "false",
+    })
+    assert cfg.device_dtype == "float64"
+    assert cfg.exact_fallback is False
+    with pytest.raises(_config.ConfigError, match="mosaic.device.dtype"):
+        _config.MosaicConfig.from_confs(
+            {"mosaic.device.dtype": "float16"})
+
+
+def test_io_on_error_conf():
+    cfg = _config.MosaicConfig.from_confs(
+        {"mosaic.io.on.error": "skip"})
+    assert cfg.io_on_error == "skip"
+    with pytest.raises(_config.ConfigError, match="mosaic.io.on.error"):
+        _config.MosaicConfig.from_confs(
+            {"mosaic.io.on.error": "maybe"})
+
+
+def test_unknown_keys_open_vs_strict():
+    # from_confs mirrors Spark's open conf namespace: unknown keys pass
+    cfg = _config.MosaicConfig.from_confs({"spark.executor.cores": "4"})
+    assert cfg == _config.MosaicConfig()
+    # apply_conf is the strict programmatic/SET path: typos must raise
+    with pytest.raises(_config.ConfigError, match="unknown conf key"):
+        _config.apply_conf(cfg, "mosaic.raster.blocksized", "128")
+
+
+def test_sql_set_statement_updates_default_config():
+    from mosaic_tpu.functions.context import MosaicContext
+    from mosaic_tpu.sql.engine import SQLError, SQLSession
+    prev = _config.default_config()
+    try:
+        s = SQLSession(
+            MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)"))
+        out = s.sql("SET mosaic.raster.blocksize = 256")
+        assert out.columns["key"] == ["mosaic.raster.blocksize"]
+        assert _config.default_config().raster_blocksize == 256
+        with pytest.raises(SQLError, match="mosaic.raster.blocksize"):
+            s.sql("SET mosaic.raster.blocksize = banana")
+        with pytest.raises(SQLError, match="unknown conf key"):
+            s.sql("SET mosaic.raster.blocksized = 128")
+    finally:
+        _config.set_default_config(prev)
+
+
+# --------------------------------------------- fixture restore semantics
+
+def test_fault_plan_fixture_restores_previous(fault_plan):
+    outer = faults.arm("seed=11;site=outer,fails=1")
+    try:
+        prev = faults.active()
+        assert prev is outer
+        # nested arm via the fixture's callable replaces...
+        fault_plan("seed=12;site=inner,fails=1")
+        assert faults.active() is not outer
+    finally:
+        faults.disarm()
