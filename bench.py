@@ -21,10 +21,12 @@ isolated on the same machine (allocator/arena pollution from the
 stages that preceded it), which the round-4 judge read as a 52% code
 regression.  Headline numbers must not depend on stage order.
 
-PERF GUARD (round-5): after measuring, the script compares against the
-most recent same-platform BENCH_r*.json and prints a loud
-`PERF REGRESSION` stderr line (and a JSON field) for any tracked
-metric that slipped >20%.
+PERF GUARD (round-5, trajectory since round-8): after measuring, the
+script compares against the median of the last 3 same-platform
+BENCH_r*.json records and prints a loud `PERF REGRESSION` stderr line
+(and a JSON field) for any tracked metric that slipped >20% against
+its median — a single noisy historical record can no longer mask or
+fabricate a regression.
 
 Robustness: the axon TPU backend can hang (not error) at first device op
 when the tunnel is down, so the platform is probed in a subprocess with a
@@ -41,7 +43,10 @@ BENCH record carries a ``metrics`` block — per-stage span histograms
 bench span, per-device peak-memory gauges, and collective/shard
 accounting from a sharded-join dryrun.  ``flagship_join_p95_ms``
 (tail latency of the steady-state loop) joins the perf-guard's
-lower-is-better set.  ``--smoke`` runs a CPU-only miniature (tiny
+lower-is-better set.  The whole run executes under one ``bench``
+trace context, the record carries XLA ``cost_analysis()`` flops/bytes
+of the compiled flagship kernel (``xla_cost``) and the path of a
+Prometheus text-format metrics snapshot (``openmetrics_path``).  ``--smoke`` runs a CPU-only miniature (tiny
 batches, 8 virtual host devices for the dryrun mesh, secondary stages
 skipped, perf_guard skipped) for CI.
 
@@ -118,9 +123,11 @@ def probe_log_tail(n: int = 12):
     return out[-n:]
 
 
-def last_same_platform_bench(platform: str):
-    """(round_tag, record) of the newest BENCH_r*.json on ``platform``."""
-    best = None
+def same_platform_benches(platform: str):
+    """All ``(round_tag, record)`` BENCH_r*.json entries on
+    ``platform``, oldest first — the trajectory the perf guard
+    compares against."""
+    out = []
     for path in sorted(glob.glob(os.path.join(HERE, "BENCH_r*.json"))):
         try:
             rec = json.loads(open(path).read().strip().splitlines()[-1])
@@ -128,35 +135,49 @@ def last_same_platform_bench(platform: str):
             continue
         if rec.get("platform") == platform:
             m = re.search(r"BENCH_r(\d+)", path)
-            best = (m.group(1) if m else path, rec)
-    return best
+            out.append((m.group(1) if m else path, rec))
+    return out
 
 
-def perf_guard(current: dict, platform: str, slip: float = 0.20):
-    """Compare tracked metrics vs the last same-platform record.
+def perf_guard(current: dict, platform: str, slip: float = 0.20,
+               window: int = 3):
+    """Compare tracked metrics vs the same-platform trajectory.
 
-    Returns a list of human-readable regression strings (empty = ok).
-    Lower-is-better metrics and higher-is-better metrics are listed
-    explicitly; anything slipping > ``slip`` fractionally is flagged."""
-    prev = last_same_platform_bench(platform)
-    if prev is None:
+    The baseline for each metric is the **median of the last
+    ``window`` same-platform records** (fewer when history is short) —
+    one noisy record can neither mask a real regression nor flag a
+    phantom one, which comparing only the single newest record did
+    both of.  Returns a list of human-readable regression strings
+    (empty = ok).  Lower-is-better metrics and higher-is-better
+    metrics are listed explicitly; anything slipping > ``slip``
+    fractionally against its median is flagged."""
+    hist = same_platform_benches(platform)[-window:]
+    if not hist:
         return []
-    tag, old = prev
+    tags = "+".join(tag for tag, _ in hist)
     lower_better = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
                     "tessellate_zones_s",
                     "tessellate_counties_s", "overlay_s",
                     "overlay_area_s", "real_zones_join_s",
                     "raster_to_grid_s"]
     higher_better = ["value", "knn_rows_per_sec"]
+
+    def median_of(key):
+        vals = [rec[key] for _, rec in hist
+                if isinstance(rec.get(key), (int, float)) and rec[key]]
+        return float(np.median(vals)) if vals else None
+
     msgs = []
     for k in lower_better:
-        a, b = old.get(k), current.get(k)
+        a, b = median_of(k), current.get(k)
         if a and b and b > a * (1.0 + slip):
-            msgs.append(f"{k}: {a} -> {b} (+{(b/a-1)*100:.0f}% vs r{tag})")
+            msgs.append(f"{k}: median {a:g} -> {b} "
+                        f"(+{(b/a-1)*100:.0f}% vs r{tags})")
     for k in higher_better:
-        a, b = old.get(k), current.get(k)
+        a, b = median_of(k), current.get(k)
         if a and b and b < a * (1.0 - slip):
-            msgs.append(f"{k}: {a} -> {b} ({(b/a-1)*100:.0f}% vs r{tag})")
+            msgs.append(f"{k}: median {a:g} -> {b} "
+                        f"({(b/a-1)*100:.0f}% vs r{tags})")
     return msgs
 
 
@@ -193,9 +214,31 @@ def main():
     # The tracer is pure host bookkeeping — it wraps stage boundaries,
     # never device code, so the measured numbers are unchanged.
     from mosaic_tpu.obs import (install_jax_listeners, metrics,
-                                sample_memory, tracer)
+                                new_trace, record_cost_analysis,
+                                sample_memory, to_openmetrics, tracer)
     tracer.enable()                 # also enables the metrics registry
     install_jax_listeners()
+    # one trace context for the whole run: every bench stage span (and
+    # the spans inside the ops they drive) groups into a single "bench"
+    # lane in the Chrome-trace export / report()["traces"].  Entered
+    # for the life of the process — the record is printed and the
+    # process exits, so there is nothing after the trace to pollute.
+    new_trace("bench").__enter__()
+
+    def write_openmetrics():
+        """Metrics snapshot in Prometheus text format next to the
+        BENCH record (scrape-file handoff, e.g. node_exporter's
+        textfile collector)."""
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            f"mosaic_bench_{os.getpid()}.prom")
+        try:
+            with open(path, "w") as f:
+                f.write(to_openmetrics())
+        except OSError as e:
+            log(f"openmetrics snapshot failed: {e}")
+            return None
+        return path
 
     # ------------------------------------------------------ FLAGSHIP
     # (must stay the FIRST measured stage — see module docstring)
@@ -232,6 +275,20 @@ def main():
     with tracer.span("bench/flagship_compile"):
         out = jax.block_until_ready(stepc(pts))
     log(f"compile+first step: {time.time()-t0:.1f}s on {platform}")
+
+    # XLA cost-model attribution of the flagship kernel: flops/bytes
+    # of the compiled join step as xla/*/flagship_join gauges, so the
+    # BENCH record carries hardware-model cost next to wall time
+    # (compilation-cache hit: the step above already compiled it)
+    try:
+        xla_cost = record_cost_analysis(
+            "flagship_join", stepc.lower(pts).compile())
+    except Exception as e:
+        log(f"cost_analysis unavailable on {platform}: {e}")
+        xla_cost = {}
+    if xla_cost:
+        log("flagship xla cost: " +
+            ", ".join(f"{k}={v:.3e}" for k, v in sorted(xla_cost.items())))
 
     # steady state: distinct device-resident batches per launch so no
     # layer (XLA, runtime, tunnel) can replay a previous result.
@@ -314,6 +371,7 @@ def main():
         "flagship_join_p95_ms": p95_ms,
         "uncertain_frac": round(unc_frac, 8),
         "tessellate_zones_s": round(t_tess, 2),
+        "xla_cost": xla_cost,
     }
 
     if smoke:
@@ -324,6 +382,7 @@ def main():
             "spans": obs_rep.get("spans", {}),
         }
         record["probes"] = PROBE_EVENTS
+        record["openmetrics_path"] = write_openmetrics()
         print(json.dumps(record))
         return
 
@@ -509,6 +568,7 @@ def main():
         "raster_to_grid_cells": len(r2g),
         "probes": PROBE_EVENTS,
         "probe_log_tail": probe_log_tail(),
+        "openmetrics_path": write_openmetrics(),
     })
     regressions = perf_guard(record, platform)
     for msg in regressions:

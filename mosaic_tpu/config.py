@@ -26,6 +26,9 @@ MOSAIC_RASTER_BLOCKSIZE = "mosaic.raster.blocksize"
 # reference leans on the Spark UI; see mosaic_tpu/obs/).
 MOSAIC_TRACE_ENABLED = "mosaic.trace.enabled"
 MOSAIC_METRICS_ENABLED = "mosaic.metrics.enabled"
+# Slow-query flight-recorder dump threshold in milliseconds; 0 (the
+# default) disables the automatic dump (see mosaic_tpu/obs/recorder.py).
+MOSAIC_OBS_SLOW_QUERY_MS = "mosaic.obs.slow.query.ms"
 MOSAIC_CRS_STRICT_DATUM = "mosaic.crs.strict.datum"
 # Precision-policy keys (fields existed since round 1; the conf spelling
 # maps onto them so conf-driven deployments can set the policy too).
@@ -71,6 +74,9 @@ class MosaicConfig:
     # override these to on; conf keys only ever turn instruments on.
     trace_enabled: bool = False
     metrics_enabled: bool = False
+    # SQLSession.sql() calls slower than this many milliseconds trigger
+    # an automatic flight-recorder dump; 0 disables the trigger.
+    obs_slow_query_ms: float = 0.0
     # Raise (instead of warn) when a CRS transform would silently apply
     # an identity datum shift because the EPSG registry carries no
     # Helmert parameters for the code (helmert_acc is NaN).
@@ -132,6 +138,17 @@ def _as_on_error(key: str, value) -> str:
     return s
 
 
+def _as_millis(key: str, value) -> float:
+    try:
+        ms = float(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not a number of milliseconds") from None
+    if ms < 0:
+        raise ConfigError(f"{key}={ms} must be >= 0 (0 disables)")
+    return ms
+
+
 def _as_str(key: str, value) -> str:
     return str(value)
 
@@ -148,6 +165,7 @@ _CONF_FIELDS = {
     MOSAIC_EXACT_FALLBACK: ("exact_fallback", _as_flag),
     MOSAIC_TRACE_ENABLED: ("trace_enabled", _as_flag),
     MOSAIC_METRICS_ENABLED: ("metrics_enabled", _as_flag),
+    MOSAIC_OBS_SLOW_QUERY_MS: ("obs_slow_query_ms", _as_millis),
     MOSAIC_CRS_STRICT_DATUM: ("crs_strict_datum", _as_flag),
     MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
 }
@@ -164,7 +182,13 @@ def apply_conf(cfg: MosaicConfig, key: str, value) -> MosaicConfig:
             f"unknown conf key {key!r} (known: "
             f"{', '.join(sorted(_CONF_FIELDS))})")
     field, coerce = _CONF_FIELDS[key]
-    return dataclasses.replace(cfg, **{field: coerce(key, value)})
+    coerced = coerce(key, value)
+    # config mutations are flight-recorder events: a post-mortem bundle
+    # shows which SET preceded the failure (lazy import — obs imports
+    # this module back for bundle snapshots)
+    from .obs.recorder import recorder
+    recorder.record("config", key=key, value=str(value))
+    return dataclasses.replace(cfg, **{field: coerced})
 
 
 _default_config: MosaicConfig = MosaicConfig()

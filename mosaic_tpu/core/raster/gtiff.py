@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ...resilience import faults
+from ...obs.context import traced
 from ...resilience.ingest import ErrorSink, decode_guard
 from .tile import GeoTransform, RasterTile
 
@@ -140,6 +141,7 @@ def _epsg_from_geokeys(entry, bo: str) -> Optional[int]:
     return projected if projected is not None else geographic
 
 
+@traced("ingest:gtiff", "ingest/gtiff")
 def read_gtiff(data: bytes, on_error: Optional[str] = None,
                path: Optional[str] = None) -> RasterTile:
     """Decode GeoTIFF bytes into a RasterTile (reference entry:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 from ..core.geometry.array import GeometryArray
 from ..core.geometry.wkb import read_wkb, write_wkb
 from ..resilience import faults
+from ..obs.context import traced
 from ..resilience.ingest import CodecError, ErrorSink, decode_guard
 
 __all__ = ["read_gpkg", "write_gpkg", "gpkg_layers"]
@@ -64,6 +65,7 @@ def gpkg_layers(path: str) -> List[str]:
         con.close()
 
 
+@traced("ingest:gpkg", "ingest/gpkg")
 def read_gpkg(path: str, layer: Optional[str] = None,
               on_error: Optional[str] = None,
               errors: Optional[list] = None

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..core.raster.tile import GeoTransform, RasterTile
 from ..resilience import faults
+from ..obs.context import traced
 from ..resilience.ingest import CodecError, ErrorSink, decode_guard
 
 __all__ = ["read_grib", "grib_subdatasets"]
@@ -231,6 +232,7 @@ def _read_grib2(data: bytes, off: int, end: int, mi: int,
         pos += slen
 
 
+@traced("ingest:grib", "ingest/grib")
 def read_grib(data: bytes, on_error: Optional[str] = None,
               path: Optional[str] = None,
               errors: Optional[list] = None) -> Dict[str, RasterTile]:

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..core.raster.tile import GeoTransform, RasterTile
 from ..resilience import faults
+from ..obs.context import traced
 from ..resilience.ingest import ErrorSink, decode_guard
 
 __all__ = ["read_netcdf", "write_netcdf", "netcdf_subdatasets"]
@@ -53,6 +54,7 @@ def _read_att_values(buf: bytes, i: int):
     return np.frombuffer(raw, dt, cnt), i
 
 
+@traced("ingest:netcdf", "ingest/netcdf")
 def read_netcdf(data: bytes, on_error: Optional[str] = None,
                 path: Optional[str] = None,
                 errors: Optional[list] = None) -> Dict[str, RasterTile]:

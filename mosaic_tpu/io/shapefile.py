@@ -25,6 +25,7 @@ import numpy as np
 from ..core.geometry.array import (GeometryArray, GeometryBuilder,
                                    GeometryType)
 from ..resilience import faults
+from ..obs.context import traced
 from ..resilience.ingest import ErrorSink, decode_guard
 
 __all__ = ["read_shapefile", "write_shapefile", "read_vector"]
@@ -106,6 +107,7 @@ def _prj_to_epsg(wkt: str) -> int:
     return 4326
 
 
+@traced("ingest:shapefile", "ingest/shapefile")
 def read_shapefile(path: str, on_error: Optional[str] = None,
                    errors: Optional[list] = None
                    ) -> Tuple[GeometryArray, Dict[str, list]]:

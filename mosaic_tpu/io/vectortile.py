@@ -24,6 +24,7 @@ import numpy as np
 
 from ..core.geometry.array import GeometryArray, GeometryType
 from ..resilience import faults
+from ..obs.context import traced
 from ..resilience.ingest import ErrorSink, decode_guard
 
 __all__ = ["tile_envelope_4326", "st_asmvttileagg",
@@ -304,6 +305,7 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
         shift += 7
 
 
+@traced("ingest:mvt", "ingest/mvt")
 def decode_mvt(blob: bytes, on_error: Optional[str] = None,
                path: Optional[str] = None,
                errors: Optional[list] = None) -> dict:

@@ -1,40 +1,69 @@
-"""Observability subsystem: metrics, tracing, JAX telemetry, export.
+"""Observability subsystem: metrics, tracing, flight recorder, export.
 
 Grown out of ``mosaic_tpu.utils.trace`` (which remains as a compat
-shim).  Four parts:
+shim).  Seven parts:
 
 * ``obs.metrics`` — process-global registry of counters, gauges, and
   exponential-bucket histograms (p50/p95/p99 derivable).
 * ``obs.tracer`` — span timer feeding per-stage histograms and a
   Chrome-trace event ring; plus the GDALCalc-style raster provenance
   helpers and ``device_trace``.
+* ``obs.context`` — query-scoped :class:`TraceContext`
+  (contextvar-propagated, thread-inheriting) so concurrent SQL
+  queries / ingests / parallel ops get distinct span trees.
+* ``obs.recorder`` — the always-on flight recorder: a bounded
+  structured event ring with ``dump()`` bundles and automatic
+  dump-on-unhandled-error / dump-on-slow-query.
 * ``obs.jaxmon`` — ``jax.monitoring`` listeners (compile/recompile
-  accounting, recompile-storm flagging) and per-device memory
-  watermarks from ``Device.memory_stats()``.
-* ``obs.chrometrace`` — Perfetto-loadable JSON export of host spans.
+  accounting, recompile-storm flagging), per-device memory watermarks
+  from ``Device.memory_stats()``, and XLA ``cost_analysis()`` gauges.
+* ``obs.chrometrace`` — Perfetto-loadable JSON export of host spans,
+  one lane per trace.
+* ``obs.openmetrics`` — Prometheus text exposition
+  (``metrics.to_openmetrics()``) and the stdlib ``serve_metrics(port)``
+  scrape endpoint.
 
-Everything is disabled by default and costs one attribute check per
-instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
+The tracer and registry are disabled by default and cost one attribute
+check per instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
 ``MOSAIC_TPU_METRICS=1``, the ``mosaic.trace.enabled`` /
 ``mosaic.metrics.enabled`` conf keys, or ``tracer.enable()`` /
-``metrics.enable()``.
+``metrics.enable()``.  The flight recorder is **on** by default
+(disable with ``MOSAIC_TPU_RECORDER=0``) and shares the same
+one-attribute-check quiescent cost.
 """
 
 from __future__ import annotations
 
 from .chrometrace import chrome_trace_events, export_chrome_trace
-from .jaxmon import STORM_THRESHOLD, install_jax_listeners, sample_memory
+from .context import (TraceContext, current_trace, current_trace_id,
+                      install_thread_propagation, new_trace, root_trace,
+                      traced)
+from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
+                     record_cost_analysis, sample_memory)
 from .metrics import Histogram, MetricsRegistry, metrics
-from .tracer import (Tracer, device_trace, record_command, record_error,
-                     tracer)
+from .openmetrics import serve_metrics, to_openmetrics
+from .recorder import FlightRecorder, install_excepthook, recorder
+from .tracer import (SpanEvent, Tracer, device_trace, record_command,
+                     record_error, tracer)
 
 __all__ = [
     "Histogram", "MetricsRegistry", "metrics",
-    "Tracer", "tracer", "record_command", "record_error", "device_trace",
+    "Tracer", "tracer", "SpanEvent",
+    "record_command", "record_error", "device_trace",
+    "TraceContext", "new_trace", "root_trace", "current_trace",
+    "current_trace_id", "traced", "install_thread_propagation",
+    "FlightRecorder", "recorder", "install_excepthook",
     "install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
+    "record_cost_analysis",
     "chrome_trace_events", "export_chrome_trace",
+    "to_openmetrics", "serve_metrics",
     "configure",
 ]
+
+# Process-wide one-time installs: trace contexts must survive into
+# worker threads, and any unhandled crash should leave a flight bundle.
+install_thread_propagation()
+install_excepthook()
 
 
 def configure(config) -> None:

@@ -1,0 +1,148 @@
+"""Query-scoped trace contexts: trace ids, span parentage, propagation.
+
+Reference counterpart: the Spark UI groups task timelines per *query*
+(SQL execution id); our flat span tracer could not tell two concurrent
+``SQLSession.sql()`` calls apart.  A :class:`TraceContext` is a small
+immutable (trace id, name) pair carried in a ``contextvars.ContextVar``
+so everything that runs under it — SQL operator stages, parallel ops,
+codec ingest, bench stages — records spans keyed by the same trace id.
+
+* :func:`new_trace` — always opens a *fresh* trace (one per SQL query,
+  per bench run).
+* :func:`root_trace` — joins the active trace when one exists, else
+  opens a fresh one (parallel ops and codec reads: standalone calls get
+  their own trace, calls inside a query inherit the query's).
+* :func:`traced` — decorator form of ``root_trace`` + a tracer span,
+  used to instrument codec entry points without touching their bodies.
+
+``contextvars`` do **not** flow into new ``threading.Thread``s by
+default; :func:`install_thread_propagation` (installed once at
+``mosaic_tpu.obs`` import) wraps ``Thread.start`` so a thread spawned
+*while a trace is active* inherits the spawner's context snapshot.
+Threads spawned with no active trace are started untouched, so
+unrelated machinery (jax pools, test runners) sees zero change.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import itertools
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["TraceContext", "new_trace", "root_trace", "current_trace",
+           "current_trace_id", "next_span_id", "traced",
+           "install_thread_propagation"]
+
+_trace_ids = itertools.count(1)
+_span_ids = itertools.count(1)
+
+
+def next_span_id() -> int:
+    """Process-unique span id (parent/child links in trace trees)."""
+    return next(_span_ids)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One trace: a process-unique id plus a human-readable name
+    (``sql:SELECT ...``, ``ingest:shapefile``, ``bench``)."""
+
+    trace_id: str
+    name: str
+
+
+_CTX: "contextvars.ContextVar[Optional[TraceContext]]" = \
+    contextvars.ContextVar("mosaic_trace_ctx", default=None)
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The active trace context, or None outside any trace."""
+    return _CTX.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _CTX.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+@contextlib.contextmanager
+def new_trace(name: str):
+    """Open a fresh trace context (always a new trace id)."""
+    ctx = TraceContext(
+        trace_id=f"t{os.getpid()}-{next(_trace_ids):05d}", name=name)
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+
+
+@contextlib.contextmanager
+def root_trace(name: str):
+    """Join the active trace, or open a fresh one when none is active."""
+    ctx = _CTX.get()
+    if ctx is not None:
+        yield ctx
+        return
+    with new_trace(name) as ctx:
+        yield ctx
+
+
+def traced(trace_name: str, span_name: Optional[str] = None):
+    """Decorator: run ``fn`` under ``root_trace(trace_name)`` and a
+    tracer span (one-line instrumentation for codec entry points and
+    parallel-op drivers).  The span only exists when the tracer is on;
+    the trace context is always established so recorder events from the
+    body carry a trace id."""
+    span = span_name or trace_name.replace(":", "/")
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from .tracer import tracer
+            with root_trace(trace_name):
+                with tracer.span(span):
+                    return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+# ------------------------------------------- thread context inheritance
+
+_patch_lock = threading.Lock()
+_patched = False
+
+
+def install_thread_propagation() -> bool:
+    """Make new threads inherit the spawner's trace context (once per
+    process).  Returns True if this call performed the installation.
+
+    Only threads started while a trace context is active are affected:
+    their ``run`` executes inside a ``contextvars`` snapshot taken at
+    ``start()`` time, so ``current_trace()`` (and the tracer's span
+    stack) carry over.  All other threads start exactly as before.
+    """
+    global _patched
+    with _patch_lock:
+        if _patched:
+            return False
+        orig_start = threading.Thread.start
+
+        @functools.wraps(orig_start)
+        def start(self):
+            if _CTX.get() is not None and \
+                    getattr(self, "_mosaic_trace_ctx", None) is None:
+                snap = contextvars.copy_context()
+                self._mosaic_trace_ctx = snap
+                orig_run = self.run
+                self.run = lambda: snap.run(orig_run)
+            orig_start(self)
+
+        threading.Thread.start = start
+        _patched = True
+        return True

@@ -28,9 +28,11 @@ import warnings
 from typing import Dict, Optional
 
 from .metrics import metrics
+from .recorder import recorder
 from .tracer import tracer
 
-__all__ = ["install_jax_listeners", "sample_memory", "STORM_THRESHOLD"]
+__all__ = ["install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
+           "record_cost_analysis"]
 
 # a label re-compiling this many times is a storm (ragged batches)
 STORM_THRESHOLD = 8
@@ -45,6 +47,12 @@ _storms_flagged = set()
 
 
 def _on_duration(name: str, dur: float, **kw) -> None:
+    if name == _BACKEND_COMPILE:
+        # the flight recorder is on even with metrics off: a crash
+        # bundle should show which compiles preceded the failure
+        recorder.record("jax_compile",
+                        label=tracer.current_label() or "<toplevel>",
+                        dur_s=round(float(dur), 6))
     if not metrics.enabled:
         return
     if name == _BACKEND_COMPILE:
@@ -118,6 +126,40 @@ def sample_memory(devices=None) -> Dict[str, Dict[str, Optional[float]]]:
         metrics.gauge_max(f"mem/peak_bytes/{key}", peak)
     if host_peak:
         metrics.gauge_max("mem/host_peak_rss_bytes", float(host_peak))
+    return out
+
+
+def record_cost_analysis(label: str, compiled) -> Dict[str, float]:
+    """Record XLA cost-model figures of a compiled function as gauges.
+
+    ``compiled`` is a ``jax.stages.Compiled``
+    (``jit(f).lower(args).compile()``) or an already-extracted
+    ``cost_analysis()`` result (plain dict, or the single-element list
+    older jax versions return).  Records ``xla/<figure>/<label>``
+    gauges (``flops``, ``bytes_accessed``, ``transcendentals``) plus a
+    recorder event, and returns the figures — ``{}`` when the backend
+    exposes no cost model, never raises.
+    """
+    try:
+        ca = compiled.cost_analysis() \
+            if hasattr(compiled, "cost_analysis") else compiled
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for key in ("flops", "bytes accessed", "transcendentals"):
+        v = ca.get(key)
+        if v is None:
+            continue
+        fig = key.replace(" ", "_")
+        out[fig] = float(v)
+        metrics.gauge(f"xla/{fig}/{label}", float(v))
+    if out:
+        recorder.record("xla_cost", label=label,
+                        **{k: v for k, v in out.items()})
     return out
 
 

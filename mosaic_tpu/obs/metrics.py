@@ -178,6 +178,19 @@ class MetricsRegistry:
             h = self._hists.get(name)
         return h.percentile(q) if h is not None else 0.0
 
+    def histograms(self) -> Dict[str, Histogram]:
+        """Live histogram objects by name (the OpenMetrics exporter
+        needs raw bucket counts, not the percentile snapshot)."""
+        with self._lock:
+            return dict(self._hists)
+
+    # -- export
+    def to_openmetrics(self) -> str:
+        """Prometheus/OpenMetrics text exposition of the registry
+        (see ``obs.openmetrics``)."""
+        from .openmetrics import to_openmetrics
+        return to_openmetrics(self)
+
     # -- reporting
     def report(self) -> Dict[str, object]:
         with self._lock:

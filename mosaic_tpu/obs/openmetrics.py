@@ -1,0 +1,113 @@
+"""OpenMetrics / Prometheus text exposition + stdlib scrape endpoint.
+
+Reference counterpart: the reference's runtime counters surface through
+Spark's Dropwizard metric sinks (JMX/Prometheus servlet); standalone we
+render the ``obs.metrics`` registry in the Prometheus text exposition
+format so any Prometheus/OpenMetrics scraper ingests it unchanged:
+
+* counters  -> ``mosaic_<name>_total``
+* gauges    -> ``mosaic_<name>``
+* histograms -> cumulative ``_bucket{le="..."}`` series (the registry's
+  exponential buckets, non-empty ones only, plus ``+Inf``), ``_count``,
+  ``_sum``
+
+Metric names are sanitized to ``[a-zA-Z0-9_]`` under a ``mosaic_``
+namespace prefix (``sql/scan_s`` -> ``mosaic_sql_scan_s``).
+
+:func:`serve_metrics` starts a stdlib-only ``ThreadingHTTPServer`` on a
+daemon thread serving ``GET /metrics`` — no third-party client library,
+matching the package's no-new-deps rule.
+"""
+
+from __future__ import annotations
+
+import http.server
+import math
+import re
+import threading
+from typing import List, Optional
+
+from .metrics import MetricsRegistry, _bucket_upper, metrics
+
+__all__ = ["to_openmetrics", "serve_metrics"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: Prometheus content type for the text exposition format
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _sanitize(name: str) -> str:
+    s = _NAME_RE.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return "mosaic_" + s
+
+
+def _fmt(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return f"{float(v):.10g}"
+
+
+def to_openmetrics(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render a registry (default: the process-global one) in the
+    Prometheus text exposition format, terminated by ``# EOF``."""
+    reg = registry if registry is not None else metrics
+    rep = reg.report()
+    lines: List[str] = []
+    for name, v in sorted(rep["counters"].items()):
+        m = _sanitize(name) + "_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(v)}")
+    for name, v in sorted(rep["gauges"].items()):
+        m = _sanitize(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(v)}")
+    for name, h in sorted(reg.histograms().items()):
+        m = _sanitize(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for i, c in enumerate(h.counts):
+            if c:
+                cum += c
+                le = _fmt(_bucket_upper(i, h.scale))
+                lines.append(f'{m}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{m}_count {h.count}")
+        lines.append(f"{m}_sum {_fmt(h.sum)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def serve_metrics(port: int = 9464, addr: str = "127.0.0.1",
+                  registry: Optional[MetricsRegistry] = None):
+    """Start a scrape endpoint on a daemon thread; returns the server.
+
+    ``GET /metrics`` (or ``/``) answers with :func:`to_openmetrics` at
+    scrape time.  Pass ``port=0`` for an ephemeral port — the bound one
+    is ``server.server_address[1]``.  Stop with ``server.shutdown()``.
+    """
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            body = to_openmetrics(registry).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    server = http.server.ThreadingHTTPServer((addr, port), _Handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="mosaic-metrics-http", daemon=True)
+    thread.start()
+    return server

@@ -1,0 +1,228 @@
+"""Flight recorder: always-on bounded ring of structured events.
+
+Reference counterpart: the Spark event log + UI survive a failed query
+and answer "what just happened"; standalone we keep a process-global
+:class:`FlightRecorder` — a bounded, lock-cheap ring of small dict
+events that is **on by default** (even with the tracer off) and costs
+one attribute check per probe when disabled.
+
+What lands in the ring: span completions (when the tracer is on),
+retry attempts/recoveries/giveups, armed fault-plan firings, codec
+``ErrorRecord``s from degrade-not-die ingestion, JAX backend-compile
+events, config mutations, SQL query begin/slow-query marks, and dump
+marks themselves.  Every event automatically carries the active trace
+id (see ``obs.context``), so a dump reconstructs the failing span
+chain of the query that died.
+
+``dump()`` writes a self-contained JSON bundle — events + metrics
+snapshot + resolved config + jax platform/device info — to
+``MOSAIC_TPU_DUMP_DIR`` (default: a ``mosaic_tpu_flight`` dir under
+the system tempdir).  Automatic dumps: unhandled exceptions (via a
+chained ``sys.excepthook``, installed at ``mosaic_tpu.obs`` import)
+and slow SQL queries (``mosaic.obs.slow.query.ms`` conf).
+
+Env knobs: ``MOSAIC_TPU_RECORDER=0`` disables, ``MOSAIC_TPU_RECORDER_EVENTS``
+sizes the ring (default 4096), ``MOSAIC_TPU_DUMP_DIR`` redirects dumps.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .context import current_trace
+
+__all__ = ["FlightRecorder", "recorder", "install_excepthook"]
+
+_DEF_CAPACITY = 4096
+
+
+def _jax_info() -> Dict[str, Any]:
+    """Platform/device snapshot for bundles — best-effort, and only if
+    jax is already imported (a crash dump must never *initialize* a
+    backend)."""
+    if "jax" not in sys.modules:
+        return {"imported": False}
+    try:
+        import jax
+        devs = jax.devices()
+        return {
+            "imported": True,
+            "version": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": len(devs),
+            "devices": [f"{d.platform}:{d.id}" for d in devs],
+        }
+    except Exception as e:  # backend init failures must not mask dumps
+        return {"imported": True, "error": f"{type(e).__name__}: {e}"}
+
+
+class FlightRecorder:
+    """Bounded structured event ring; one attribute check per
+    ``record()`` when disabled."""
+
+    def __init__(self):
+        env = os.environ.get("MOSAIC_TPU_RECORDER", "").strip().lower()
+        self._enabled = env not in ("0", "off", "false", "no")
+        try:
+            cap = int(os.environ.get("MOSAIC_TPU_RECORDER_EVENTS",
+                                     _DEF_CAPACITY))
+        except ValueError:
+            cap = _DEF_CAPACITY
+        self._lock = threading.Lock()
+        self._events: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=max(16, cap))
+        self._seq = 0
+        self._dumps = 0
+
+    # -- switches
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    def reset(self, capacity: Optional[int] = None) -> None:
+        """Clear the ring; optionally resize it (tests exercise bounds
+        with a small ring)."""
+        with self._lock:
+            if capacity is not None:
+                self._events = collections.deque(
+                    maxlen=max(16, int(capacity)))
+            else:
+                self._events.clear()
+            self._seq = 0
+
+    # -- the probe
+    def record(self, kind: str, **fields) -> None:
+        """Append one structured event.  The active trace id (if any)
+        is attached automatically."""
+        if not self._enabled:
+            return
+        ev: Dict[str, Any] = {"seq": 0, "ts": time.time(), "kind": kind}
+        ctx = current_trace()
+        if ctx is not None:
+            ev["trace"] = ctx.trace_id
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Snapshot of retained events, oldest first (optionally
+        filtered by kind)."""
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e.get("kind") == kind]
+
+    # -- bundles
+    def bundle(self, reason: str = "manual",
+               error: Optional[str] = None) -> Dict[str, Any]:
+        """Self-contained post-mortem: events + metrics snapshot +
+        resolved config + jax platform info."""
+        import dataclasses
+
+        from .metrics import metrics
+        try:
+            from .. import config as _config
+            cfg = dataclasses.asdict(_config.default_config())
+        except Exception:
+            cfg = {}
+        b: Dict[str, Any] = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "events": self.events(),
+            "metrics": metrics.report(),
+            "config": cfg,
+            "jax": _jax_info(),
+        }
+        if error is not None:
+            b["error"] = error
+        return b
+
+    def dump(self, path: Optional[str] = None, reason: str = "manual",
+             error: Optional[str] = None) -> str:
+        """Write a bundle as JSON (atomic rename); returns the path."""
+        b = self.bundle(reason=reason, error=error)
+        if path is None:
+            d = os.environ.get("MOSAIC_TPU_DUMP_DIR") or os.path.join(
+                tempfile.gettempdir(), "mosaic_tpu_flight")
+            os.makedirs(d, exist_ok=True)
+            with self._lock:
+                self._dumps += 1
+                n = self._dumps
+            path = os.path.join(
+                d, f"flight_{os.getpid()}_{n:03d}_{reason}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(b, f, default=str)
+        os.replace(tmp, path)
+        self.record("dump", path=path, reason=reason)
+        return path
+
+    @contextlib.contextmanager
+    def dump_on_error(self, reason: str = "unhandled_error"):
+        """Dump a bundle when the body raises, then re-raise."""
+        try:
+            yield
+        except BaseException as e:
+            msg = f"{type(e).__name__}: {e}"[:300]
+            self.record("error", error=msg)
+            try:
+                self.dump(reason=reason, error=msg)
+            except OSError:
+                pass
+            raise
+
+
+recorder = FlightRecorder()
+
+
+# ------------------------------------------------ crash auto-dump
+
+_hook_lock = threading.Lock()
+_hook_installed = False
+
+
+def install_excepthook() -> bool:
+    """Chain a ``sys.excepthook`` that dumps a flight bundle on any
+    unhandled exception (once per process).  The previous hook always
+    runs afterwards."""
+    global _hook_installed
+    with _hook_lock:
+        if _hook_installed:
+            return False
+        prev = sys.excepthook
+
+        def hook(tp, val, tb):
+            try:
+                if recorder.enabled:
+                    msg = f"{tp.__name__}: {val}"[:300]
+                    recorder.record("unhandled_error", error=msg)
+                    recorder.dump(reason="unhandled_error", error=msg)
+            except Exception:
+                pass
+            prev(tp, val, tb)
+
+        sys.excepthook = hook
+        _hook_installed = True
+        return True

@@ -16,6 +16,12 @@ supply the equivalent surface ourselves:
   or ``MOSAIC_TPU_TRACE=1``.  ``MosaicContext.call`` wraps every by-name
   dispatch in a span, so external engines driving the string surface get
   per-function wall times for free.
+* **Trace-scoped span trees** — the span stack lives in a
+  ``contextvars.ContextVar`` (not a thread-local), so it follows the
+  active :class:`~mosaic_tpu.obs.context.TraceContext`: every completed
+  span carries its trace id, a process-unique span id, and its parent's
+  span id.  ``report()["traces"]`` groups spans per trace;
+  two interleaved SQL queries land in two distinct trees.
 * ``record_command`` / ``record_error`` — the GDALCalc metadata pattern:
   raster operators stamp what ran (and what failed) into ``tile.meta``;
   both also bump registry counters so fleet-wide rates are visible.
@@ -26,24 +32,50 @@ supply the equivalent surface ourselves:
 
 ``tracer.enable()`` also enables the metrics registry (span call-sites
 feed counters/gauges into it); ``disable()`` turns the registry back off
-unless ``MOSAIC_TPU_METRICS`` asked for it independently.
+unless ``MOSAIC_TPU_METRICS`` asked for it independently.  Completed
+spans additionally land in the flight recorder (``obs.recorder``) so a
+crash dump contains the failing span chain.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import contextvars
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
+from .context import current_trace, next_span_id
 from .metrics import Histogram, metrics
+from .recorder import recorder
 
-__all__ = ["Tracer", "tracer", "record_command", "record_error",
-           "device_trace"]
+__all__ = ["Tracer", "tracer", "SpanEvent", "record_command",
+           "record_error", "device_trace"]
 
 _MAX_EVENTS = 100_000   # bounded Chrome-trace ring (~10 MB of JSON)
+
+#: active span stack: tuple of (name, span_id) pairs.  A ContextVar
+#: (copy-on-write tuples) instead of a thread-local list so the stack
+#: follows the trace context across threads and executors.
+_SPAN_STACK: "contextvars.ContextVar[Tuple[Tuple[str, int], ...]]" = \
+    contextvars.ContextVar("mosaic_span_stack", default=())
+
+
+class SpanEvent(NamedTuple):
+    """One completed span in the event ring."""
+
+    qual: str                  # qualified name ("outer/inner")
+    start_s: float             # offset from the tracer epoch
+    dur_s: float
+    tid: int                   # python thread ident
+    native_tid: int            # OS thread id (Perfetto lanes)
+    trace_id: Optional[str]    # active TraceContext (None outside)
+    trace_name: Optional[str]
+    span_id: int
+    parent_id: Optional[int]
+    error: Optional[str]       # "ExcType: msg" when the body raised
 
 
 class _Span:
@@ -66,8 +98,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: Dict[str, _Span] = {}
         self._counters: Dict[str, float] = {}
-        self._stack = threading.local()
-        self._events: "collections.deque[Tuple[str, float, float, int]]" \
+        self._events: "collections.deque[SpanEvent]" \
             = collections.deque(maxlen=_MAX_EVENTS)
         self._epoch = time.perf_counter()
 
@@ -99,32 +130,47 @@ class Tracer:
         if not self._enabled:
             yield
             return
-        stack: List[str] = getattr(self._stack, "names", None) or []
-        self._stack.names = stack
-        stack.append(name)
-        qual = "/".join(stack)
+        stack = _SPAN_STACK.get()
+        sid = next_span_id()
+        parent = stack[-1][1] if stack else None
+        qual = "/".join([n for n, _ in stack] + [name])
+        token = _SPAN_STACK.set(stack + ((name, sid),))
         t0 = time.perf_counter()
+        err: Optional[str] = None
         try:
             yield
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"[:200]
+            raise
         finally:
-            t1 = time.perf_counter()
-            dt = t1 - t0
-            stack.pop()
+            dt = time.perf_counter() - t0
+            _SPAN_STACK.reset(token)
+            ctx = current_trace()
+            try:
+                ntid = threading.get_native_id()
+            except Exception:
+                ntid = threading.get_ident()
+            ev = SpanEvent(
+                qual, t0 - self._epoch, dt, threading.get_ident(),
+                ntid, ctx.trace_id if ctx else None,
+                ctx.name if ctx else None, sid, parent, err)
             with self._lock:
                 s = self._spans.setdefault(qual, _Span(qual))
                 s.total_s += dt
                 s.calls += 1
                 s.max_s = max(s.max_s, dt)
                 s.hist.observe(dt)
-                self._events.append(
-                    (qual, t0 - self._epoch, dt, threading.get_ident()))
+                self._events.append(ev)
+            extra = {"error": err} if err else {}
+            recorder.record("span", name=qual, span=sid,
+                            parent=parent, dur_s=round(dt, 6), **extra)
 
     def current_label(self) -> Optional[str]:
-        """Innermost active span on this thread (None outside spans).
+        """Innermost active span in this context (None outside spans).
         Used by ``obs.jaxmon`` to attribute anonymous JAX compile events
         to whatever stage triggered them."""
-        stack = getattr(self._stack, "names", None)
-        return "/".join(stack) if stack else None
+        stack = _SPAN_STACK.get()
+        return "/".join(n for n, _ in stack) if stack else None
 
     # -- counters
     def count(self, name: str, value: float = 1.0) -> None:
@@ -134,9 +180,9 @@ class Tracer:
             self._counters[name] = self._counters.get(name, 0.0) + value
 
     # -- Chrome-trace events
-    def events(self) -> List[Tuple[str, float, float, int]]:
-        """Snapshot of (qualified name, start offset s, duration s,
-        thread id) complete-span events, oldest first."""
+    def events(self) -> List[SpanEvent]:
+        """Snapshot of completed :class:`SpanEvent` records, oldest
+        first."""
         with self._lock:
             return list(self._events)
 
@@ -144,7 +190,9 @@ class Tracer:
     def report(self) -> Dict[str, object]:
         """One-stop snapshot: per-stage span histograms plus everything
         the metrics registry holds (counters merged; tracer-local names
-        win on collision)."""
+        win on collision), plus per-trace span trees under
+        ``"traces"``: ``{trace_id: {"name": ..., "spans": [...]}}``
+        with each span carrying ``span_id``/``parent_id`` links."""
         reg = metrics.report()
         with self._lock:
             spans = {}
@@ -155,11 +203,24 @@ class Tracer:
                             "p95_s": h["p95"], "p99_s": h["p99"]}
             counters = dict(reg["counters"])
             counters.update(self._counters)
+            traces: Dict[str, dict] = {}
+            for ev in self._events:
+                if ev.trace_id is None:
+                    continue
+                t = traces.setdefault(
+                    ev.trace_id, {"name": ev.trace_name, "spans": []})
+                rec = {"name": ev.qual, "span_id": ev.span_id,
+                       "parent_id": ev.parent_id, "start_s": ev.start_s,
+                       "dur_s": ev.dur_s, "thread": ev.native_tid}
+                if ev.error:
+                    rec["error"] = ev.error
+                t["spans"].append(rec)
             return {
                 "spans": spans,
                 "counters": counters,
                 "gauges": reg["gauges"],
                 "histograms": reg["histograms"],
+                "traces": traces,
             }
 
     def format_report(self) -> str:
@@ -171,6 +232,11 @@ class Tracer:
             lines.append(f"{n:<44} {s['calls']:>6} "
                          f"{s['total_s']:>9.4f} {s['p50_s']:>8.4f} "
                          f"{s['p95_s']:>8.4f} {s['max_s']:>8.4f}")
+        for tid, t in sorted(rep["traces"].items()):
+            errs = sum(1 for s in t["spans"] if s.get("error"))
+            lines.append(f"trace {tid} ({t['name']}): "
+                         f"{len(t['spans'])} spans"
+                         + (f", {errs} errored" if errs else ""))
         for n, v in sorted(rep["counters"].items()):
             lines.append(f"counter {n} = {v:g}")
         for n, v in sorted(rep["gauges"].items()):

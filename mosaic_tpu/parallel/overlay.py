@@ -38,6 +38,7 @@ import numpy as np
 from ..core.geometry.array import GeometryArray
 from ..core.index.base import IndexSystem
 from ..core.tessellate import tessellate
+from ..obs.context import traced
 from ..resilience import faults
 from ..types import ChipSet
 
@@ -490,6 +491,7 @@ def _exchange_rows(cell, row, edges, valid, D: int, axis: str,
     return flat(rc), flat(rr), flat(re), flat(rv), overflow
 
 
+@traced("overlay", "overlay/row_pairs")
 def overlay_row_pairs(chips_a, chips_b, polys_a: GeometryArray,
                       polys_b: GeometryArray, res: int,
                       grid: IndexSystem, mesh=None,
@@ -575,6 +577,7 @@ def overlay_row_pairs(chips_a, chips_b, polys_a: GeometryArray,
     return valid // row_mult, valid % row_mult
 
 
+@traced("overlay", "overlay/intersection_area")
 def overlay_intersection_area(polys_a: GeometryArray,
                               polys_b: GeometryArray, res: int,
                               grid: IndexSystem, mesh=None,
@@ -645,6 +648,7 @@ def overlay_host_truth(polys_a: GeometryArray,
 
 # -------------------------------------------------------------- end2end
 
+@traced("overlay", "overlay/intersects")
 def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
                        res: int, grid: IndexSystem, mesh=None,
                        axis: str = "data") -> np.ndarray:

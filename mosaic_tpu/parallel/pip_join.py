@@ -326,7 +326,10 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
     state = {"first": True}
 
     def wrapped(points):
-        out = jfn(points)
+        from ..obs import tracer
+        from ..obs.context import root_trace
+        with root_trace("pip_join"), tracer.span("pip_join/sharded"):
+            out = jfn(points)
         if metrics.enabled:
             metrics.gauge("collective/replicated_index_bytes",
                           float(idx_bytes) * D)

@@ -93,6 +93,7 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
             out_specs=P(None, axis, None)))
         _JIT_CACHE[key] = fn
     from ..obs import metrics, tracer
+    from ..obs.context import root_trace
     if metrics.enabled:
         # two ppermute shifts move `halo` rows per device each way:
         # bands * halo * W f32 per device per shift, D devices
@@ -103,7 +104,7 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
     arr = jax.device_put(
         jnp.asarray(data),
         NamedSharding(mesh, P(None, axis, None)))
-    with tracer.span("halo/convolve"):
+    with root_trace("raster_halo"), tracer.span("halo/convolve"):
         out = np.asarray(fn(arr))
     return RasterTile(out, tile.gt, nodata=None, srid=tile.srid,
                       meta={"op": "convolve", "sharded": "halo"})

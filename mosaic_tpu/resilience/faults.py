@@ -178,6 +178,9 @@ class FaultPlan:
         self.injected.append((site, n, kind))
         metrics.count("faults/injected")
         metrics.count(f"faults/injected/{site}")
+        from ..obs.recorder import recorder
+        recorder.record("fault_injected", site=site, call=n,
+                        fault=kind, seed=self.seed)
 
     def reset(self) -> None:
         with self._lock:

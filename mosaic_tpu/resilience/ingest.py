@@ -29,6 +29,7 @@ import zlib
 from typing import List, Optional
 
 from ..obs import metrics
+from ..obs.recorder import recorder
 
 __all__ = ["ErrorRecord", "CodecError", "ErrorSink", "decode_guard",
            "ON_ERROR_MODES"]
@@ -101,8 +102,14 @@ def decode_guard(path: Optional[str] = None,
     except CodecError:
         raise
     except _RAW_DECODE_ERRORS as e:
-        raise CodecError(f"{type(e).__name__}: {e}", path=path,
-                         feature=feature, offset=offset) from e
+        err = CodecError(f"{type(e).__name__}: {e}", path=path,
+                         feature=feature, offset=offset)
+        # flight-recorder event regardless of on_error mode: a "raise"
+        # that escapes to the excepthook dumps with the located error
+        recorder.record("codec_error", path=path, feature=feature,
+                        offset=offset,
+                        reason=f"{type(e).__name__}: {e}"[:200])
+        raise err from e
 
 
 class ErrorSink:
@@ -146,6 +153,10 @@ class ErrorSink:
                 reason=f"{type(exc).__name__}: {exc}"[:200],
                 error_type=type(exc).__name__)
         self.records.append(rec)
+        recorder.record("codec_record_dropped", driver=self.driver,
+                        path=rec.path, feature=rec.feature,
+                        offset=rec.offset, reason=rec.reason,
+                        error_type=rec.error_type)
         metrics.count("io/records_dropped")
         metrics.count(f"io/records_dropped/{self.driver}")
 

@@ -10,8 +10,12 @@ Standalone, transient IO faults (NFS blips, a concurrently-swept native
 exponential backoff with **deterministic jitter** (seeded from the
 armed fault plan, so chaos runs replay byte-identically), an exception
 allowlist, and per-attempt obs counters (``retry/attempts/<name>``,
-``retry/recovered/<name>``, ``retry/giveups/<name>``).  Apply with
-``policy.call(fn, ...)`` or the ``retrying(policy)`` decorator.
+``retry/recovered/<name>``, ``retry/giveups/<name>``).  Each attempt
+also lands a structured ``retry`` / ``retry_recovered`` /
+``retry_giveup`` event (error text, backoff) in the flight recorder
+(``obs.recorder``), so post-hoc "which call retried and why" survives.
+Apply with ``policy.call(fn, ...)`` or the ``retrying(policy)``
+decorator.
 """
 
 from __future__ import annotations
@@ -24,6 +28,7 @@ import time
 from typing import Callable, Optional, Tuple, Type
 
 from ..obs import metrics
+from ..obs.recorder import recorder
 from . import faults
 
 __all__ = ["RetryPolicy", "retrying", "CHECKPOINT_RETRY",
@@ -76,6 +81,8 @@ class RetryPolicy:
                 out = fn(*args, **kwargs)
                 if attempt:
                     metrics.count(f"retry/recovered/{self.name}")
+                    recorder.record("retry_recovered", policy=self.name,
+                                    attempts=attempt + 1)
                 return out
             except self.retry_on as e:
                 last = e
@@ -84,9 +91,16 @@ class RetryPolicy:
                     break
                 if on_retry is not None:
                     on_retry(e, attempt)
-                sleep(self.delay(attempt))
+                delay = self.delay(attempt)
+                recorder.record("retry", policy=self.name,
+                                attempt=attempt, backoff_s=round(delay, 6),
+                                error=f"{type(e).__name__}: {e}"[:200])
+                sleep(delay)
         metrics.count(f"retry/giveups/{self.name}")
         assert last is not None
+        recorder.record("retry_giveup", policy=self.name,
+                        attempts=max(1, self.max_attempts),
+                        error=f"{type(last).__name__}: {last}"[:200])
         raise last
 
 

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core.geometry.array import GeometryArray
-from ..obs import metrics, tracer
+from ..obs import metrics, new_trace, recorder, tracer
 from .parser import (Binary, Call, Column, Literal, Query, SelectItem,
                      Star, Unary, parse)
 
@@ -188,7 +188,35 @@ class SQLSession:
         returns the plan without executing.  ``SET mosaic.key = value``
         updates the session-default :class:`MosaicConfig` through the
         validated conf path (reference: ``spark.conf.set`` on the
-        mosaic.* namespace) and returns the applied pair."""
+        mosaic.* namespace) and returns the applied pair.
+
+        Every call runs under a fresh :class:`TraceContext` (the
+        Spark-UI "one timeline per SQL execution" analogue): operator
+        stages become child spans of an ``sql/query`` root span, keyed
+        by the query's trace id in ``tracer.report()["traces"]`` and
+        the Chrome-trace export.  Queries slower than
+        ``mosaic.obs.slow.query.ms`` (when > 0) trigger an automatic
+        flight-recorder dump."""
+        label = " ".join(query.split())[:60]
+        t0 = time.perf_counter()
+        with new_trace(f"sql:{label}") as ctx:
+            recorder.record("sql", query=label)
+            with tracer.span("sql/query"):
+                out = self._sql_impl(query)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        from .. import config as _config
+        threshold = _config.default_config().obs_slow_query_ms
+        if threshold and dt_ms > threshold:
+            recorder.record("slow_query", query=label,
+                            ms=round(dt_ms, 3), threshold_ms=threshold,
+                            trace=ctx.trace_id)
+            try:
+                recorder.dump(reason="slow_query")
+            except OSError:
+                pass
+        return out
+
+    def _sql_impl(self, query: str) -> Table:
         import re as _re
         m = _re.match(r"\s*SET\s+([A-Za-z][\w.]*)\s*=\s*(.+?)\s*;?\s*$",
                       query, _re.IGNORECASE)
@@ -247,7 +275,9 @@ class SQLSession:
 
     def _execute(self, q: Query, prof: Optional[List[tuple]]) -> Table:
         def stage(op: str, detail: str, fn, rows_of):
-            with tracer.span(f"sql/{op}"):
+            # nested under the sql/query root span -> qualified as
+            # sql/query/<op>, a child in the query's trace tree
+            with tracer.span(op):
                 t0 = time.perf_counter()
                 res = fn()
                 dt = time.perf_counter() - t0

@@ -1,0 +1,405 @@
+"""Flight recorder, trace contexts, and OpenMetrics export.
+
+Covers the PR-4 observability surface: ring bounds, dump-on-error
+bundle shape (failing span chain + ErrorRecord + metrics snapshot),
+the slow-query trigger, trace-id propagation across threads, recorder
+events from an armed fault plan, retry events, and an OpenMetrics
+round-trip through a live scrape of ``serve_metrics``.
+"""
+
+import json
+import struct
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mosaic_tpu as mos
+from mosaic_tpu import config as _config
+from mosaic_tpu.obs import (chrome_trace_events, current_trace_id,
+                            install_jax_listeners, metrics, new_trace,
+                            recorder, root_trace, serve_metrics,
+                            to_openmetrics, tracer)
+from mosaic_tpu.resilience import faults
+from mosaic_tpu.resilience.ingest import CodecError, ErrorSink, decode_guard
+from mosaic_tpu.resilience.retry import RetryPolicy
+
+
+@pytest.fixture
+def clean_obs():
+    """Fresh tracer + recorder + registry for one test."""
+    recorder.reset()
+    recorder.enable()
+    tracer.reset()
+    tracer.enable()
+    yield
+    tracer.disable()
+    tracer.reset()
+    recorder.reset()
+
+
+@pytest.fixture
+def clean_config():
+    """Restore the session-default config after the test."""
+    prev = _config.default_config()
+    yield
+    _config.set_default_config(prev)
+
+
+@pytest.fixture
+def session():
+    ctx = mos.enable_mosaic("CUSTOM(-180,180,-90,90,2,360,180)")
+    s = mos.SQLSession(ctx)
+    s.create_table("pts", {"x": np.arange(100.0),
+                           "y": np.arange(100.0) / 10.0})
+    return s
+
+
+# ------------------------------------------------------------- ring
+
+def test_ring_is_bounded(clean_obs):
+    recorder.reset(capacity=32)
+    try:
+        for i in range(100):
+            recorder.record("tick", i=i)
+        evs = recorder.events("tick")
+        assert len(evs) == 32
+        # oldest events fell off the front, newest survived
+        assert evs[0]["i"] == 68 and evs[-1]["i"] == 99
+        # seq stays monotonically increasing across the wrap
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs)
+    finally:
+        recorder.reset(capacity=4096)
+
+
+def test_disabled_recorder_records_nothing(clean_obs):
+    recorder.disable()
+    recorder.record("tick")
+    assert recorder.events() == []
+    recorder.enable()
+    recorder.record("tick")
+    assert len(recorder.events("tick")) == 1
+
+
+# ------------------------------------------------- dump-on-error
+
+def test_dump_on_error_bundle_shape(clean_obs, clean_config, tmp_path,
+                                    monkeypatch):
+    """A forced codec error dumps a bundle holding the failing span
+    chain, the located error, and a metrics snapshot."""
+    monkeypatch.setenv("MOSAIC_TPU_DUMP_DIR", str(tmp_path))
+    metrics.count("io/records_dropped")      # something to snapshot
+    with pytest.raises(CodecError):
+        with recorder.dump_on_error(reason="test_error"):
+            with new_trace("ingest:broken") as ctx:
+                with tracer.span("read_file"):
+                    with tracer.span("decode_strip"):
+                        with decode_guard(path="f.bin",
+                                          feature="strip 3", offset=77):
+                            raise struct.error("unpack requires more")
+    dumps = list(tmp_path.glob("*_test_error.json"))
+    assert len(dumps) == 1
+    b = json.loads(dumps[0].read_text())
+    assert b["reason"] == "test_error"
+    assert b["error"].startswith("CodecError")
+    # metrics snapshot + resolved config + jax platform info
+    assert b["metrics"]["counters"]["io/records_dropped"] == 1
+    assert b["config"]["index_system"]
+    assert "jax" in b
+    # the located codec error event, attributed to the trace
+    (ce,) = [e for e in b["events"] if e["kind"] == "codec_error"]
+    assert ce["feature"] == "strip 3" and ce["offset"] == 77
+    assert ce["trace"] == ctx.trace_id
+    # the failing span chain: both spans errored, child links parent
+    spans = {e["name"]: e for e in b["events"] if e["kind"] == "span"}
+    child = spans["read_file/decode_strip"]
+    parent = spans["read_file"]
+    assert child["parent"] == parent["span"]
+    assert child["error"].startswith("CodecError")
+    assert parent["error"].startswith("CodecError")
+    assert child["trace"] == parent["trace"] == ctx.trace_id
+
+
+def test_error_sink_drop_lands_in_recorder(clean_obs):
+    sink = ErrorSink("skip", driver="grib", path="g.grib")
+    with pytest.raises(CodecError):
+        # decode_guard locates, sink.handle absorbs
+        with decode_guard(path="g.grib", feature="message 2", offset=9):
+            raise IndexError("short buffer")
+    try:
+        with decode_guard(path="g.grib", feature="message 2", offset=9):
+            raise IndexError("short buffer")
+    except CodecError as e:
+        sink.handle(e)
+    (ev,) = recorder.events("codec_record_dropped")
+    assert ev["driver"] == "grib" and ev["feature"] == "message 2"
+    assert sink.dropped() == 1
+
+
+# ------------------------------------------------- slow-query dump
+
+def test_slow_query_triggers_dump(clean_obs, clean_config, session,
+                                  tmp_path, monkeypatch):
+    monkeypatch.setenv("MOSAIC_TPU_DUMP_DIR", str(tmp_path))
+    cfg = _config.apply_conf(_config.default_config(),
+                             _config.MOSAIC_OBS_SLOW_QUERY_MS, "0.0001")
+    _config.set_default_config(cfg)
+    session.sql("SELECT x FROM pts WHERE y > 1.0")
+    dumps = list(tmp_path.glob("*_slow_query.json"))
+    assert len(dumps) == 1
+    b = json.loads(dumps[0].read_text())
+    (sq,) = [e for e in b["events"] if e["kind"] == "slow_query"]
+    assert sq["ms"] > sq["threshold_ms"]
+    assert sq["query"].startswith("SELECT x FROM pts")
+    # the slow query's trace id points at its span tree in the bundle
+    q_spans = [e for e in b["events"]
+               if e["kind"] == "span" and e.get("trace") == sq["trace"]]
+    assert any(e["name"] == "sql/query" for e in q_spans)
+
+
+def test_no_dump_when_threshold_unset(clean_obs, clean_config, session,
+                                      tmp_path, monkeypatch):
+    monkeypatch.setenv("MOSAIC_TPU_DUMP_DIR", str(tmp_path))
+    session.sql("SELECT x FROM pts")
+    assert list(tmp_path.glob("*.json")) == []
+
+
+def test_slow_query_conf_validates(clean_config):
+    with pytest.raises(_config.ConfigError):
+        _config.apply_conf(_config.default_config(),
+                           _config.MOSAIC_OBS_SLOW_QUERY_MS, "-5")
+    with pytest.raises(_config.ConfigError):
+        _config.apply_conf(_config.default_config(),
+                           _config.MOSAIC_OBS_SLOW_QUERY_MS, "soon")
+
+
+def test_config_mutation_is_recorded(clean_obs, clean_config):
+    _config.apply_conf(_config.default_config(),
+                       _config.MOSAIC_IO_ON_ERROR, "skip")
+    (ev,) = recorder.events("config")
+    assert ev["key"] == _config.MOSAIC_IO_ON_ERROR
+    assert ev["value"] == "skip"
+
+
+# --------------------------------------------------- trace contexts
+
+def test_trace_id_propagates_across_threads(clean_obs):
+    seen = {}
+
+    def worker():
+        seen["trace"] = current_trace_id()
+        with tracer.span("worker_span"):
+            pass
+
+    with new_trace("parent") as ctx:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["trace"] == ctx.trace_id
+    spans = tracer.report()["traces"][ctx.trace_id]["spans"]
+    assert [s["name"] for s in spans] == ["worker_span"]
+
+
+def test_thread_without_trace_is_untouched(clean_obs):
+    seen = {}
+    t = threading.Thread(
+        target=lambda: seen.update(trace=current_trace_id()))
+    t.start()
+    t.join()
+    assert seen["trace"] is None
+
+
+def test_root_trace_joins_active_trace(clean_obs):
+    with new_trace("outer") as outer:
+        with root_trace("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+    with root_trace("standalone") as alone:
+        assert alone.trace_id != outer.trace_id
+        assert alone.name == "standalone"
+
+
+def test_interleaved_queries_get_distinct_trace_trees(clean_obs,
+                                                      session):
+    """The acceptance shape: two interleaved sql() calls -> two trace
+    ids, each with a correctly-parented span tree, in report() and in
+    the Chrome-trace export."""
+    barrier = threading.Barrier(2, timeout=30)
+    results = {}
+
+    def run(tag, query):
+        barrier.wait()               # both queries in flight together
+        results[tag] = session.sql(query)
+
+    t1 = threading.Thread(target=run,
+                          args=("a", "SELECT x FROM pts WHERE y > 1.0"))
+    t2 = threading.Thread(target=run,
+                          args=("b", "SELECT y FROM pts ORDER BY y DESC"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert len(results["a"]) == 89 and len(results["b"]) == 100
+
+    traces = tracer.report()["traces"]
+    sql_traces = {tid: t for tid, t in traces.items()
+                  if t["name"].startswith("sql:")}
+    assert len(sql_traces) == 2
+    for tid, t in sql_traces.items():
+        by_name = {s["name"]: s for s in t["spans"]}
+        root = by_name["sql/query"]
+        assert root["parent_id"] is None
+        # every operator stage is a direct child of the query root
+        stages = [s for n, s in by_name.items()
+                  if n.startswith("sql/query/")]
+        assert stages, t
+        assert all(s["parent_id"] == root["span_id"] for s in stages)
+    # span ids never collide across the two traces
+    ids_a, ids_b = [set(s["span_id"] for s in t["spans"])
+                    for t in sql_traces.values()]
+    assert not (ids_a & ids_b)
+
+    # Chrome-trace export: one lane per query, labelled by trace id
+    doc = chrome_trace_events()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"
+          and e["args"].get("trace_id") in sql_traces]
+    assert {e["args"]["trace_id"] for e in xs} == set(sql_traces)
+    lane_of = {}
+    for e in xs:
+        lane_of.setdefault(e["args"]["trace_id"], set()).add(e["tid"])
+    # the two queries never share a lane
+    a_lanes, b_lanes = lane_of.values()
+    assert not (a_lanes & b_lanes)
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "thread_name"}
+    for tid in sql_traces:
+        assert any(tid in n for n in names)
+
+
+def test_explain_analyze_rows_are_trace_spans(clean_obs, session):
+    out = session.sql("EXPLAIN ANALYZE SELECT x FROM pts WHERE y > 5.0")
+    ops = list(out.columns["operator"])
+    traces = tracer.report()["traces"]
+    (trace,) = [t for t in traces.values()
+                if t["name"].startswith("sql:EXPLAIN")]
+    span_names = {s["name"] for s in trace["spans"]}
+    for op in ops:
+        assert f"sql/query/{op}" in span_names
+
+
+# ------------------------------------------------ resilience events
+
+def test_fault_plan_firings_land_in_recorder(clean_obs, fault_plan):
+    plan = fault_plan("seed=7;site=recorder.test,fails=2")
+    with pytest.raises(OSError):
+        faults.maybe_fail("recorder.test")
+    with pytest.raises(OSError):
+        faults.maybe_fail("recorder.test")
+    faults.maybe_fail("recorder.test")       # third call: clean
+    evs = recorder.events("fault_injected")
+    assert [(e["site"], e["call"]) for e in evs] == \
+        [("recorder.test", 0), ("recorder.test", 1)]
+    assert all(e["seed"] == 7 for e in evs)
+    assert len(plan.injected) == 2
+
+
+def test_retry_attempts_land_in_recorder(clean_obs):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(f"blip {calls['n']}")
+        return "ok"
+
+    policy = RetryPolicy(name="rec.test", max_attempts=4,
+                         base_delay_s=0.0, jitter=0.0)
+    assert policy.call(flaky, sleep=lambda _s: None) == "ok"
+    attempts = recorder.events("retry")
+    assert [e["attempt"] for e in attempts] == [0, 1]
+    assert all(e["policy"] == "rec.test" and "blip" in e["error"]
+               for e in attempts)
+    (rec_ev,) = recorder.events("retry_recovered")
+    assert rec_ev["attempts"] == 3
+
+    with pytest.raises(OSError):
+        policy.call(lambda: (_ for _ in ()).throw(OSError("dead")),
+                    sleep=lambda _s: None)
+    (gu,) = recorder.events("retry_giveup")
+    assert gu["policy"] == "rec.test" and "dead" in gu["error"]
+
+
+def test_jax_compile_recorded_with_metrics_off(clean_obs):
+    """The recorder sees backend compiles even when the registry is
+    disabled — crash bundles must show pre-crash compile activity."""
+    import jax
+    import jax.numpy as jnp
+    install_jax_listeners()
+    tracer.disable()                 # registry off too
+    assert not metrics.enabled
+    jax.jit(lambda v: v * 3 + 1)(jnp.arange(7))
+    assert recorder.events("jax_compile")
+    tracer.enable()
+
+
+# ------------------------------------------------------ openmetrics
+
+def test_to_openmetrics_exposition(clean_obs):
+    metrics.count("io/records_dropped", 3)
+    metrics.gauge("shard/skew/pip_join", 1.25)
+    for v in (0.001, 0.002, 0.004):
+        metrics.observe("sql/scan_s", v)
+    txt = to_openmetrics()
+    assert txt.endswith("# EOF\n")
+    assert "# TYPE mosaic_io_records_dropped_total counter" in txt
+    assert "mosaic_io_records_dropped_total 3" in txt
+    assert "mosaic_shard_skew_pip_join 1.25" in txt
+    assert "# TYPE mosaic_sql_scan_s histogram" in txt
+    assert 'mosaic_sql_scan_s_bucket{le="+Inf"} 3' in txt
+    assert "mosaic_sql_scan_s_count 3" in txt
+    # cumulative buckets are nondecreasing and end at count
+    cums = [int(l.rsplit(" ", 1)[1]) for l in txt.splitlines()
+            if l.startswith("mosaic_sql_scan_s_bucket")]
+    assert cums == sorted(cums) and cums[-1] == 3
+    assert txt == metrics.to_openmetrics()
+
+
+def test_openmetrics_roundtrip_through_scrape(clean_obs):
+    metrics.count("jax/recompiles", 2)
+    metrics.observe("sql/project_s", 0.01)
+    server = serve_metrics(port=0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode("utf-8")
+        assert body == to_openmetrics()
+        assert "mosaic_jax_recompiles_total 2" in body
+        assert "mosaic_sql_project_s_sum 0.01" in body
+        # scrapes see live values: bump and scrape again
+        metrics.count("jax/recompiles", 1)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert "mosaic_jax_recompiles_total 3" in r.read().decode()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ------------------------------------------------------ bundle misc
+
+def test_bundle_carries_platform_info(clean_obs):
+    b = recorder.bundle(reason="t")
+    assert b["jax"]["imported"] is True
+    assert b["jax"]["device_count"] == 8      # conftest's virtual mesh
+    assert b["config"]["io_on_error"] in ("raise", "skip", "null")
+
+
+def test_dump_event_is_appended(clean_obs, tmp_path):
+    p = recorder.dump(path=str(tmp_path / "x.json"), reason="manual")
+    assert p == str(tmp_path / "x.json")
+    (ev,) = recorder.events("dump")
+    assert ev["path"] == p and ev["reason"] == "manual"
