@@ -50,6 +50,26 @@ Prometheus text-format metrics snapshot (``openmetrics_path``).  ``--smoke`` run
 batches, 8 virtual host devices for the dryrun mesh, secondary stages
 skipped, perf_guard skipped) for CI.
 
+PERF LAYER (round-6): x64 is enabled up front so the bucketed jitted
+classify kernels in core.tessellate run (join inputs stay f32 via
+localize(); the clip kernel opts back to the interpreted path on the
+CPU fallback, where its jitted form measures slower); the flagship
+end-to-end number is measured through the double-buffered streamed
+executor (perf.pipeline) — chunked device_put/compute/host-recheck
+overlap, so unlike round 5 it INCLUDES the host->device transfer of
+every chunk; ``device_ms`` measures the same chunk-shaped kernel over
+pre-staged device chunks (``device_launch_chunk`` rows per launch) —
+the monolithic 4M-row launch it replaces is no longer on any
+execution path; KNN steady state is the median of >=3 post-warmup
+iterations with compile time reported separately (knn_compile_s); and
+the record carries a ``jit_cache`` block (persistent-cache hit/miss +
+backend compile + process kernel-cache counters).  Set MOSAIC_TPU_JIT_CACHE_DIR (or the
+``mosaic.jit.cache.dir`` conf key) to persist compiled executables
+across processes — the CI perf-smoke lane asserts a warm start
+performs zero compiles (persistent_misses == 0; note backend_compiles
+stays nonzero on warm runs because jax.monitoring fires its
+backend-compile event on cache hits too).
+
 Prints ONE JSON line on stdout; diagnostics go to stderr.  The JSON
 carries the parity-mismatch count — a broken join cannot report a healthy
 number silently.
@@ -159,6 +179,7 @@ def perf_guard(current: dict, platform: str, slip: float = 0.20,
                     "tessellate_zones_s",
                     "tessellate_counties_s", "overlay_s",
                     "overlay_area_s", "real_zones_join_s",
+                    "union_agg_s",
                     "raster_to_grid_s"]
     higher_better = ["value", "knn_rows_per_sec"]
 
@@ -196,12 +217,32 @@ def main():
     if not on_tpu:
         log("TPU unreachable -> running on CPU (diagnostic run)")
         jax.config.update("jax_platforms", "cpu")
+        # XLA:CPU compiles the bucketed clip kernel into code slower
+        # than the interpreted half-plane driver (measured ~3x on the
+        # real-zones stage); the jitted classify/parity kernels still
+        # win there, so only the clip path opts out on the CPU
+        # fallback.  TPU runs everything jitted.
+        os.environ.setdefault("MOSAIC_TPU_DISABLE_CLIP_JIT", "1")
+    # x64 BEFORE any op: unlocks the bucketed jitted classify/clip
+    # kernels in core.tessellate (gated on _f64_jit_enabled).  Join
+    # inputs stay f32 — localize() casts after the f64 origin shift —
+    # so the flagship device numbers measure the same kernel dtypes.
+    jax.config.update("jax_enable_x64", True)
+    # persistent compilation cache (no-op unless MOSAIC_TPU_JIT_CACHE_DIR
+    # or mosaic.jit.cache.dir is set) — must be wired before the first
+    # compile so warm starts load executables from disk
+    from mosaic_tpu.perf.jit_cache import (configure_persistent_cache,
+                                           kernel_cache,
+                                           persistent_cache_dir)
+    if configure_persistent_cache():
+        log(f"persistent compilation cache: {persistent_cache_dir()}")
     import jax.numpy as jnp
     from mosaic_tpu.bench.workloads import build_workload, nyc_points
     from mosaic_tpu.parallel.pip_join import (DensePIPIndex,
                                               build_pip_index,
                                               host_recheck_fn,
                                               localize, make_pip_join_fn,
+                                              make_streamed_pip_join,
                                               pip_host_truth,
                                               zone_histogram)
 
@@ -240,6 +281,24 @@ def main():
             return None
         return path
 
+    def jit_cache_report():
+        """Compile accounting for the record + the CI warm-start
+        assertion.  ``persistent_misses`` is the ground truth for
+        "did anything actually compile": jax.monitoring still fires
+        backend_compile duration events on persistent-cache HITS (the
+        event wraps the disk lookup), so ``backend_compiles`` stays
+        nonzero even on a fully warm run."""
+        return {
+            "dir": persistent_cache_dir(),
+            "persistent_hits":
+                int(metrics.counter_value("jax/cache/cache_hits")),
+            "persistent_misses":
+                int(metrics.counter_value("jax/cache/cache_misses")),
+            "backend_compiles":
+                int(metrics.counter_value("jax/recompiles")),
+            "kernel_cache": kernel_cache.stats(),
+        }
+
     # ------------------------------------------------------ FLAGSHIP
     # (must stay the FIRST measured stage — see module docstring)
     polys, grid, res = build_workload(n_side=4 if smoke else 16,
@@ -263,26 +322,36 @@ def main():
     n_zones = len(polys)
     recheck = host_recheck_fn(idx, polys)
 
-    def step(points):
-        zone, uncertain = join(points)
-        return zone, uncertain, zone_histogram(zone, n_zones)
-
-    stepc = jax.jit(step)
-    n = 1 << 18 if smoke else 1 << 22   # 4M points per launch (full)
+    # The production execution shape is CHUNKED (round-6, perf.pipeline):
+    # a batch is joined as a sequence of fixed-shape chunk launches that
+    # the streamed executor pipelines against host transfers.  The
+    # device diagnostic below therefore launches the same chunk-shaped
+    # kernel over PRE-STAGED device chunks — the monolithic one-launch
+    # step it replaces no longer exists on any execution path, and on
+    # XLA:CPU a single 4M-row launch measures ~2x slower than the same
+    # rows chunked (working set falls out of cache).  32k rows/chunk on
+    # CPU sits on the measured throughput plateau (16k..32k); 256k on
+    # TPU keeps per-launch overhead negligible at HBM batch sizes.
+    chunk = 1 << 18 if on_tpu else 1 << 15
+    joinc = jax.jit(join)
+    histc = jax.jit(lambda z: zone_histogram(z, n_zones))
+    n = 1 << 18 if smoke else 1 << 22   # 4M points per batch (full)
     pts64 = nyc_points(n)
-    pts = jnp.asarray(localize(idx, pts64))
+    pts = jnp.asarray(localize(idx, pts64[:chunk]))
     t0 = time.time()
     with tracer.span("bench/flagship_compile"):
-        out = jax.block_until_ready(stepc(pts))
-    log(f"compile+first step: {time.time()-t0:.1f}s on {platform}")
+        z0, _ = joinc(pts)
+        jax.block_until_ready(histc(z0))
+    log(f"compile+first chunk ({chunk} rows): {time.time()-t0:.1f}s "
+        f"on {platform}")
 
     # XLA cost-model attribution of the flagship kernel: flops/bytes
-    # of the compiled join step as xla/*/flagship_join gauges, so the
-    # BENCH record carries hardware-model cost next to wall time
-    # (compilation-cache hit: the step above already compiled it)
+    # of the compiled chunk-shaped join as xla/*/flagship_join gauges,
+    # so the BENCH record carries hardware-model cost next to wall
+    # time (compilation-cache hit: the chunk above already compiled)
     try:
         xla_cost = record_cost_analysis(
-            "flagship_join", stepc.lower(pts).compile())
+            "flagship_join", joinc.lower(pts).compile())
     except Exception as e:
         log(f"cost_analysis unavailable on {platform}: {e}")
         xla_cost = {}
@@ -290,38 +359,56 @@ def main():
         log("flagship xla cost: " +
             ", ".join(f"{k}={v:.3e}" for k, v in sorted(xla_cost.items())))
 
-    # steady state: distinct device-resident batches per launch so no
-    # layer (XLA, runtime, tunnel) can replay a previous result.
-    # End-to-end per batch = device join + flag transfer + f64 host
-    # recheck of flagged points (the exactness contract's full cost —
-    # round 2 reported device time only, VERDICT.md What's-weak #2).
+    # steady state: distinct device-resident batches per iteration so
+    # no layer (XLA, runtime, tunnel) can replay a previous result.
+    # device_ms = join + zone histogram over every chunk of a batch,
+    # data already on device — the pure-device floor under the
+    # end-to-end streamed number measured next.
     iters = 3 if smoke else 5
     host_batches = [nyc_points(n, seed=100 + i) for i in range(iters)]
-    batches = [jax.device_put(jnp.asarray(localize(idx, hb)))
-               for hb in host_batches]
+    batches = []
+    for hb in host_batches:
+        loc = np.asarray(localize(idx, hb))
+        batches.append([jax.device_put(jnp.asarray(loc[s:s + chunk]))
+                        for s in range(0, n, chunk)])
     jax.block_until_ready(batches)
-    dev_times, e2e_times, unc_total, matched = [], [], 0, 0
+    dev_times, matched = [], 0
     for i in range(iters):
         with tracer.span("bench/flagship_join"):
             t0 = time.time()
-            z, u, h = stepc(batches[i])
-            jax.block_until_ready((z, u, h))
-            t1 = time.time()
-            zh = np.asarray(z)
-            uh = np.asarray(u)
-            zh = recheck(host_batches[i], zh, uh)
-            t2 = time.time()
-        dev_times.append(t1 - t0)
-        e2e_times.append(t2 - t0)
-        unc_total += int(uh.sum())
-        matched += int(np.asarray(h).sum())
+            hs = []
+            for c in batches[i]:
+                z, _u = joinc(c)
+                hs.append(histc(z))
+            jax.block_until_ready(hs)
+            dev_times.append(time.time() - t0)
+        matched += int(sum(np.asarray(h).sum() for h in hs))
+
+    # end-to-end via the double-buffered streamed executor
+    # (perf.pipeline.stream): device_put of chunk N+1 overlaps compute
+    # on chunk N and the f64 host recheck of flagged points drains on a
+    # worker thread behind the device — unlike the round-5 loop this
+    # timing INCLUDES the host->device transfer of every chunk, i.e. it
+    # is the full cost of joining points that start in host memory.
+    sjoin = make_streamed_pip_join(idx, grid, polys=polys, chunk=chunk)
+    with tracer.span("bench/flagship_stream_warm"):
+        sjoin(host_batches[0])      # compile the chunk-shaped kernel
+    e2e_times, unc_total = [], 0
+    for i in range(iters):
+        with tracer.span("bench/flagship_stream"):
+            t0 = time.time()
+            _, rechecked = sjoin(host_batches[i])
+            e2e_times.append(time.time() - t0)
+        unc_total += int(rechecked)
     sample_memory(jax.devices())    # mem/peak_bytes/* gauges
     dt_dev = float(np.median(dev_times))
     dt = float(np.median(e2e_times))
     pps = n / dt
     unc_frac = unc_total / (iters * n)
-    log(f"{n} pts: device {dt_dev*1e3:.1f} ms, end-to-end (incl f64 "
-        f"recheck) {dt*1e3:.1f} ms -> {pps/1e6:.2f}M pts/s; "
+    log(f"{n} pts: device ({n // chunk} chunk launches) "
+        f"{dt_dev*1e3:.1f} ms, streamed "
+        f"end-to-end (incl H2D + f64 recheck, chunk={chunk}) "
+        f"{dt*1e3:.1f} ms -> {pps/1e6:.2f}M pts/s; "
         f"uncertain_frac={unc_frac:.2e}; matched "
         f"{matched/(iters*n):.3f} of points (zone histogram)")
 
@@ -367,6 +454,7 @@ def main():
         "zones": n_zones,
         "index": type(idx).__name__,
         "device_ms": round(dt_dev * 1e3, 1),
+        "device_launch_chunk": chunk,
         "end_to_end_ms": round(dt * 1e3, 1),
         "flagship_join_p95_ms": p95_ms,
         "uncertain_frac": round(unc_frac, 8),
@@ -383,6 +471,7 @@ def main():
         }
         record["probes"] = PROBE_EVENTS
         record["openmetrics_path"] = write_openmetrics()
+        record["jit_cache"] = jit_cache_report()
         print(json.dumps(record))
         return
 
@@ -470,8 +559,14 @@ def main():
     from mosaic_tpu.core.geometry.geojson import read_geojson
     feats = [json.loads(l) for l in open(_zp) if l.strip()]
     rzones = read_geojson([json.dumps(f["geometry"]) for f in feats])
-    # warm the big-ring clip/classify buckets real polygons hit
-    tessellate(rzones.take([0, 1]), 9, grid, keep_core_geom=False)
+    # warm pass over the FULL zone set: real polygons scatter across
+    # many ring-size buckets, so a 2-polygon warmup left most classify
+    # compiles inside the timed region (round-5 measured 2.3 s here,
+    # ~1.7 s of it compiles).  The warm-pass wall time is reported as
+    # excluded, same convention as the join compile below.
+    t0 = time.time()
+    tessellate(rzones, 9, grid, keep_core_geom=False)
+    t_real_tess_warm = time.time() - t0
     t0 = time.time()
     rchips = tessellate(rzones, 9, grid, keep_core_geom=False)
     t_real_tess = time.time() - t0
@@ -500,8 +595,9 @@ def main():
     log(f"real zones: {len(rzones)} NYC taxi zones x 200k points in "
         f"{t_real:.2f}s (tess {t_real_tess:.2f} + index "
         f"{t_real_index:.2f} + join {t_real_join:.2f} + recheck "
-        f"{t_real_recheck:.2f}; first-call warmup "
-        f"{t_real_compile:.2f}s excluded); parity {real_mism}/30000")
+        f"{t_real_recheck:.2f}; warmups excluded: tess "
+        f"{t_real_tess_warm:.2f}s, join {t_real_compile:.2f}s); "
+        f"parity {real_mism}/30000")
 
     # BASELINE config 4 AS SPECIFIED: AIS pings x world ports at
     # GLOBAL extent (round-4: the multi-face windows make this run on
@@ -522,9 +618,16 @@ def main():
     t0 = time.time()
     knn_out = knn.transform(pings, ports)
     t_knn_compile = time.time() - t0
-    t0 = time.time()
-    knn_out = knn.transform(pings, ports)
-    t_knn = time.time() - t0
+    # steady state = MEDIAN of >=3 post-warmup iterations (round-6:
+    # one timed run let a single allocator hiccup set the record);
+    # compile/warmup time is reported separately (knn_compile_s)
+    knn_iters = 3
+    knn_times = []
+    for _ in range(knn_iters):
+        t0 = time.time()
+        knn_out = knn.transform(pings, ports)
+        knn_times.append(time.time() - t0)
+    t_knn = float(np.median(knn_times))
     knn_pps = len(pings) / t_knn
     ref_ids, _ = knn_host_truth(pings[:20_000], ports, 5)
     knn_mism = int(np.sum(knn_out["right_id"][:20_000] != ref_ids))
@@ -549,6 +652,8 @@ def main():
         "union_agg_s": round(t_union, 2),
         "union_agg_chips": len(cchips),
         "knn_rows_per_sec": round(knn_pps),
+        "knn_compile_s": round(t_knn_compile, 2),
+        "knn_steady_iters": knn_iters,
         "knn_rows": len(pings),
         "knn_global_extent": True,
         "knn_parity_mismatches": knn_mism,
@@ -562,13 +667,15 @@ def main():
             "index_build": round(t_real_index, 2),
             "device_join": round(t_real_join, 2),
             "host_recheck": round(t_real_recheck, 2),
-            "first_call_warmup_excluded": round(t_real_compile, 2)},
+            "first_call_warmup_excluded": round(t_real_compile, 2),
+            "tessellate_warmup_excluded": round(t_real_tess_warm, 2)},
         "real_zones_parity_mismatches": real_mism,
         "raster_to_grid_s": round(t_r2g, 2),
         "raster_to_grid_cells": len(r2g),
         "probes": PROBE_EVENTS,
         "probe_log_tail": probe_log_tail(),
         "openmetrics_path": write_openmetrics(),
+        "jit_cache": jit_cache_report(),
     })
     regressions = perf_guard(record, platform)
     for msg in regressions:

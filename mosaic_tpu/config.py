@@ -38,6 +38,10 @@ MOSAIC_EXACT_FALLBACK = "mosaic.exact.fallback"
 # "raise" fail-fast (default), "skip" drop malformed records, "null"
 # null/zero-fill them — every codec threads this through.
 MOSAIC_IO_ON_ERROR = "mosaic.io.on.error"
+# Directory for JAX's persistent compilation cache (perf/jit_cache.py);
+# empty (the default) leaves the on-disk cache unconfigured.  Env var
+# MOSAIC_TPU_JIT_CACHE_DIR takes precedence over this key.
+MOSAIC_JIT_CACHE_DIR = "mosaic.jit.cache.dir"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -84,6 +88,10 @@ class MosaicConfig:
     # Codec error policy (resilience/ingest.py): what a malformed
     # record/strip/message does — fail fast, get dropped, or get nulled.
     io_on_error: str = "raise"
+    # On-disk compiled-kernel cache directory; "" leaves it off.  When
+    # set (here or via MOSAIC_TPU_JIT_CACHE_DIR), warm-started
+    # processes load XLA executables from disk instead of recompiling.
+    jit_cache_dir: str = ""
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -168,6 +176,7 @@ _CONF_FIELDS = {
     MOSAIC_OBS_SLOW_QUERY_MS: ("obs_slow_query_ms", _as_millis),
     MOSAIC_CRS_STRICT_DATUM: ("crs_strict_datum", _as_flag),
     MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
+    MOSAIC_JIT_CACHE_DIR: ("jit_cache_dir", _as_str),
 }
 
 
@@ -202,6 +211,9 @@ def set_default_config(cfg: MosaicConfig) -> None:
     if cfg.trace_enabled or cfg.metrics_enabled:
         from .obs import configure
         configure(cfg)
+    if cfg.jit_cache_dir:
+        from .perf.jit_cache import configure_persistent_cache
+        configure_persistent_cache(cfg.jit_cache_dir)
 
 
 def default_config() -> MosaicConfig:

@@ -29,6 +29,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..perf.bucketing import iter_size_buckets, pad_rows, pow2_bucket
+from ..perf.jit_cache import kernel_cache
 from ..types import ChipSet
 from .geometry.array import GeometryArray, GeometryBuilder, GeometryType
 from .index.base import IndexSystem
@@ -95,6 +97,100 @@ def _seg_cross(a1, b1, a2, b2) -> np.ndarray:
     touch = on_seg(a2, b2, a1, d1) | on_seg(a2, b2, b1, d2) | \
         on_seg(a1, b1, a2, d3) | on_seg(a1, b1, b2, d4)
     return proper | touch
+
+
+def _pair_check(a1: np.ndarray, b1: np.ndarray, a2: np.ndarray,
+                b2: np.ndarray, vmask: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact edge-cross + vertex-in-cell test for P (cell, edge) pairs.
+
+    a1/b1 [P, K, 2] = each pair's cell vertex ring (vertex and its
+    successor), a2/b2 [P, 2] = the pair's polygon edge, vmask [P, K].
+    Returns (hit [P], inside [P]): hit = the edge crosses/touches any
+    valid cell side; inside = the edge's START vertex sits inside the
+    convex CCW cell (all cross products >= 0).
+
+    This is the sparse-pair half of cell classification — previously an
+    interpreted ~20-op numpy chain per block inside both classify
+    functions.  With f64 on, pairs run through ONE jitted kernel in
+    pow2 row buckets (compiles once per (bucket, K)); the numpy branch
+    is the bit-exact fallback and parity reference."""
+    P, K = a1.shape[:2]
+    hit = np.zeros(P, dtype=bool)
+    inside = np.zeros(P, dtype=bool)
+    if P == 0:
+        return hit, inside
+    if _f64_jit_enabled():
+        import jax.numpy as jnp
+        blk = pow2_bucket(P, floor=256, cap=8192)
+        key = (blk, K)
+
+        def build():
+            import jax
+
+            def kernel(a1, b1, a2, b2, vm):
+                a2b = a2[:, None, :]
+                b2b = b2[:, None, :]
+
+                def orient(p, q, r):
+                    return (q[..., 0] - p[..., 0]) * \
+                        (r[..., 1] - p[..., 1]) - \
+                        (q[..., 1] - p[..., 1]) * \
+                        (r[..., 0] - p[..., 0])
+
+                d1 = orient(a2b, b2b, a1)
+                d2 = orient(a2b, b2b, b1)
+                d3 = orient(a1, b1, a2b)
+                d4 = orient(a1, b1, b2b)
+                proper = ((d1 > 0) != (d2 > 0)) & \
+                    ((d3 > 0) != (d4 > 0)) & \
+                    (d1 != 0) & (d2 != 0) & (d3 != 0) & (d4 != 0)
+
+                def on_seg(p, q, r, d):
+                    return (d == 0) & \
+                        (jnp.minimum(p[..., 0], q[..., 0]) <=
+                         r[..., 0]) & \
+                        (r[..., 0] <=
+                         jnp.maximum(p[..., 0], q[..., 0])) & \
+                        (jnp.minimum(p[..., 1], q[..., 1]) <=
+                         r[..., 1]) & \
+                        (r[..., 1] <=
+                         jnp.maximum(p[..., 1], q[..., 1]))
+
+                touch = on_seg(a2b, b2b, a1, d1) | \
+                    on_seg(a2b, b2b, b1, d2) | \
+                    on_seg(a1, b1, a2b, d3) | \
+                    on_seg(a1, b1, b2b, d4)
+                cross = (proper | touch) & vm
+                ev = b1 - a1
+                pvec = a2b - a1
+                crossz = ev[..., 0] * pvec[..., 1] - \
+                    ev[..., 1] * pvec[..., 0]
+                ins = jnp.all((crossz >= 0) | ~vm, axis=1)
+                return cross.any(axis=1), ins
+
+            return jax.jit(kernel)
+
+        fn = kernel_cache.get_or_build("tess/pair_check", key, build)
+        for s in range(0, P, blk):
+            e = min(s + blk, P)
+            n = e - s
+            h, i2 = fn(jnp.asarray(pad_rows(a1[s:e], blk)),
+                       jnp.asarray(pad_rows(b1[s:e], blk)),
+                       jnp.asarray(pad_rows(a2[s:e], blk)),
+                       jnp.asarray(pad_rows(b2[s:e], blk)),
+                       jnp.asarray(pad_rows(vmask[s:e], blk, False)))
+            hit[s:e] = np.asarray(h)[:n]
+            inside[s:e] = np.asarray(i2)[:n]
+        return hit, inside
+    a2b = a2[:, None, :]
+    b2b = b2[:, None, :]
+    hit = (_seg_cross(a1, b1, a2b, b2b) & vmask).any(axis=1)
+    ev = b1 - a1
+    pvec = a2b - a1
+    crossz = ev[..., 0] * pvec[..., 1] - ev[..., 1] * pvec[..., 0]
+    inside = np.all((crossz >= 0) | ~vmask, axis=1)
+    return hit, inside
 
 
 def classify_cells(cell_verts: np.ndarray, cell_counts: np.ndarray,
@@ -164,18 +260,12 @@ def classify_cells(cell_verts: np.ndarray, cell_counts: np.ndarray,
                                k[None, :] + 1)
             cv_next = np.take_along_axis(cell_verts, nxt_idx[:, :, None],
                                          axis=1)
-            a1 = cell_verts[ci]                       # [P, K, 2]
-            b1 = cv_next[ci]
-            a2 = edges[ei, 0][:, None, :]             # [P, 1, 2]
-            b2 = edges[ei, 1][:, None, :]
-            hit = _seg_cross(a1, b1, a2, b2) & vmask[ci]
-            np.logical_or.at(crossed, ci, hit.any(axis=1))
-            # polygon (start-)vertex inside convex CCW cell
-            ev = cv_next - cell_verts                 # [M, K, 2]
-            pvec = edges[ei, 0][:, None, :] - a1      # [P, K, 2]
-            crossz = ev[ci][..., 0] * pvec[..., 1] - \
-                ev[ci][..., 1] * pvec[..., 0]
-            inside = np.all((crossz >= 0) | ~vmask[ci], axis=1)
+            # exact crossing + polygon-(start-)vertex-inside-cell, one
+            # bucketed kernel over the sparse pairs
+            hit, inside = _pair_check(cell_verts[ci], cv_next[ci],
+                                      edges[ei, 0], edges[ei, 1],
+                                      vmask[ci])
+            np.logical_or.at(crossed, ci, hit)
             np.logical_or.at(inside_cell, ci, inside)
 
     core = all_in & ~crossed & ~inside_cell
@@ -237,9 +327,6 @@ def _sh_halfplane(subj, counts, p0, p1, active):
     return new_subj, new_count
 
 
-_PARITY_JIT = {}
-
-
 def _parity_block(eg: np.ndarray, px: np.ndarray, py: np.ndarray,
                   block: int) -> np.ndarray:
     """Crossing parity of Q query points per pair vs the pair's own
@@ -256,10 +343,10 @@ def _parity_block(eg: np.ndarray, px: np.ndarray, py: np.ndarray,
         # 100-pair bucket of 4096-edge geometries must not compute a
         # 4096-row kernel (40x waste, round-5 real-zone profile);
         # pow2 keeps the compile count bounded
-        block = min(block, 1 << int(np.ceil(np.log2(max(b, 64)))))
+        block = min(block, pow2_bucket(b, floor=64))
         key = (block, eg.shape[1], q)
-        fn = _PARITY_JIT.get(key)
-        if fn is None:
+
+        def build():
             import jax
 
             def kernel(egj, pxj, pyj):
@@ -273,14 +360,13 @@ def _parity_block(eg: np.ndarray, px: np.ndarray, py: np.ndarray,
                 hits = straddle & (pxj[..., None] < xi)
                 return (hits.sum(axis=-1) & 1).astype(bool)
 
-            fn = jax.jit(kernel)
-            _PARITY_JIT[key] = fn
+            return jax.jit(kernel)
+
+        fn = kernel_cache.get_or_build("tess/parity", key, build)
         if b < block:
-            pad = block - b
-            eg = np.concatenate([eg, np.full(
-                (pad, *eg.shape[1:]), np.inf)])
-            px = np.concatenate([px, np.zeros((pad, q))])
-            py = np.concatenate([py, np.zeros((pad, q))])
+            eg = pad_rows(eg, block, np.inf)
+            px = pad_rows(px, block)
+            py = pad_rows(py, block)
         out = np.asarray(fn(jnp.asarray(eg), jnp.asarray(px),
                             jnp.asarray(py)))
         return out[:b]
@@ -357,16 +443,11 @@ def classify_cells_multi(cell_verts: np.ndarray,
             & (cb1[s:e0, None] <= ey1[g]) & (ey0[g] <= cb3[s:e0, None])
         ci, ei = np.nonzero(ov)
         if len(ci):
-            a1 = cell_verts[s + ci]               # [P, K, 2]
-            b1 = cv_next[s + ci]
-            a2 = eg[ci, ei, 0][:, None, :]
-            b2 = eg[ci, ei, 1][:, None, :]
-            hit = _seg_cross(a1, b1, a2, b2) & vmask[s + ci]
-            np.logical_or.at(crossed, s + ci, hit.any(axis=1))
-            ev = cv_next[s + ci] - a1
-            pvec = a2 - a1
-            crossz = ev[..., 0] * pvec[..., 1] - ev[..., 1] * pvec[..., 0]
-            inside = np.all((crossz >= 0) | ~vmask[s + ci], axis=1)
+            hit, inside = _pair_check(cell_verts[s + ci],
+                                      cv_next[s + ci],
+                                      eg[ci, ei, 0], eg[ci, ei, 1],
+                                      vmask[s + ci])
+            np.logical_or.at(crossed, s + ci, hit)
             np.logical_or.at(inside_cell, s + ci, inside)
     core = all_in & ~crossed & ~inside_cell
     touching = crossed | center_in | any_in | inside_cell | core
@@ -404,9 +485,6 @@ def _sh_all_planes(subj, counts, cv, cc):
     return subj, counts
 
 
-_CLIP_JIT = {}
-
-
 def _clip_bucket_jitted(subj: np.ndarray, counts: np.ndarray,
                         cv: np.ndarray, cc: np.ndarray):
     """All half-plane passes of one clip bucket in ONE jitted kernel.
@@ -422,8 +500,8 @@ def _clip_bucket_jitted(subj: np.ndarray, counts: np.ndarray,
     m, w = subj.shape[:2]
     kmax = cv.shape[1]
     key = (m, w, kmax)
-    fn = _CLIP_JIT.get(key)
-    if fn is None:
+
+    def build():
         def kernel(subj, counts, cv, cc):
             rows = jnp.arange(m)
             vidx = jnp.arange(w)
@@ -491,8 +569,9 @@ def _clip_bucket_jitted(subj: np.ndarray, counts: np.ndarray,
                 (subj, counts, jnp.zeros(m, bool)))
             return subj, counts, overflow
 
-        fn = jax.jit(kernel)
-        _CLIP_JIT[key] = fn
+        return jax.jit(kernel)
+
+    fn = kernel_cache.get_or_build("tess/clip", key, build)
     o1, o2, ovf = fn(jnp.asarray(subj), jnp.asarray(counts),
                      jnp.asarray(cv), jnp.asarray(cc))
     return np.asarray(o1), np.asarray(o2), np.asarray(ovf)
@@ -518,15 +597,7 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
     use_jit = _f64_jit_enabled("MOSAIC_TPU_DISABLE_CLIP_JIT")
     sizes = np.array([len(ring_pool[r]) for r in task_ring])
     kmax = clip_verts.shape[1]
-    order = np.argsort(sizes, kind="stable")
-    # pow2 size buckets
-    start = 0
-    while start < T:
-        vcur = max(4, 1 << int(np.ceil(np.log2(sizes[order[start]]))))
-        stop = start
-        while stop < T and sizes[order[stop]] <= vcur:
-            stop += 1
-        sel = order[start:stop]
+    for vcur, sel in iter_size_buckets(sizes, floor=4):
         m = len(sel)
         # pad each DISTINCT ring once, then gather per task (a ring is
         # clipped against many cells; per-task filling dominated the
@@ -553,7 +624,7 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
             # the bench warmup precompiles the common shapes.  Tiny
             # buckets use a smaller pow2 block so a 5-task bucket of
             # 4096-vertex rings does not allocate 8192-row arrays.
-            blk = min(8192, 1 << int(np.ceil(np.log2(max(m, 128)))))
+            blk = pow2_bucket(m, floor=128, cap=8192)
             so = np.zeros_like(subj)
             co = np.zeros_like(counts)
             redo_rows = []
@@ -599,7 +670,6 @@ def convex_clip_tasks(ring_pool, task_ring: np.ndarray,
             c = int(counts[i])
             if c >= 3:
                 out[t] = subj[i, :c + 1]
-        start = stop
     return out
 
 
@@ -708,16 +778,9 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
         nume = np.array([len(edges_by[g]) for g in poly_sel])
         pair_touch = np.zeros(len(pair_g), bool)
         pair_core = np.zeros(len(pair_g), bool)
-        gorder = np.argsort(nume, kind="stable")
         loc = np.full(len(arr), -1, np.int64)
-        s = 0
-        while s < len(gorder):
-            epad = max(4, 1 << int(np.ceil(np.log2(
-                max(nume[gorder[s]], 1)))))
-            e = s
-            while e < len(gorder) and nume[gorder[e]] <= epad:
-                e += 1
-            bucket = [poly_sel[j] for j in gorder[s:e]]
+        for epad, gsel in iter_size_buckets(nume, floor=4):
+            bucket = [poly_sel[j] for j in gsel]
             loc[:] = -1
             loc[bucket] = np.arange(len(bucket))
             psel = np.nonzero(loc[pair_g] >= 0)[0]
@@ -730,7 +793,6 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
                 loc[pair_g[psel]], edges_pad)
             pair_touch[psel] = t_
             pair_core[psel] = c_
-            s = e
         # ---- flat clip-task stream over border pairs
         ring_pool = []
         ring_ids = {}                # g -> ring indexes into pool

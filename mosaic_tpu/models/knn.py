@@ -52,6 +52,8 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..core.index.base import IndexSystem
+from ..perf.jit_cache import kernel_cache
+from ..perf.pipeline import donate_jit, stream
 from .core import IterationState, IterativeTransformer
 
 #: f32 tie band (degrees) at the k-th rank boundary
@@ -257,7 +259,6 @@ class SpatialKNN(IterativeTransformer):
         self.axis = axis
         self._idx: Optional[FusedKNNIndex] = None
         self._rowmap: Dict[int, np.ndarray] = {}
-        self._step_cache = {}
 
     # ------------------------------------------------------------ device
     def _make_step(self, n_off: int, idx: "FusedKNNIndex"):
@@ -272,11 +273,12 @@ class SpatialKNN(IterativeTransformer):
         import jax.numpy as jnp
         cap = idx.cap
         k = self.k
+        # the mesh identity keys the compiled shardings (a jitted fn
+        # bakes its mesh in); shapes + statics key everything else
         key = (n_off, cap, k, int(idx.entry.shape[0]),
                tuple(idx.pool_xy.shape), self.distance_threshold,
-               self.mesh is not None)
-        if key in self._step_cache:
-            return self._step_cache[key]
+               None if self.mesh is None
+               else (id(self.mesh), self.axis))
         thr2 = np.float32(np.inf) if self.distance_threshold is None \
             else np.float32(self.distance_threshold) ** 2
 
@@ -313,19 +315,20 @@ class SpatialKNN(IterativeTransformer):
                 (offs, omask))
             return top_d2, top_code
 
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            row = NamedSharding(self.mesh, P(self.axis))
-            row2 = NamedSharding(self.mesh, P(self.axis, None))
-            rep = NamedSharding(self.mesh, P())
-            fn = jax.jit(step, in_shardings=(
-                rep, rep, row2, row, row, row, row, row, row, row,
-                row2, row2, rep, rep),
-                out_shardings=(row2, row2))
-        else:
-            fn = jax.jit(step)
-        self._step_cache[key] = fn
-        return fn
+        def build():
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, \
+                    PartitionSpec as P
+                row = NamedSharding(self.mesh, P(self.axis))
+                row2 = NamedSharding(self.mesh, P(self.axis, None))
+                rep = NamedSharding(self.mesh, P())
+                return jax.jit(step, in_shardings=(
+                    rep, rep, row2, row, row, row, row, row, row, row,
+                    row2, row2, rep, rep),
+                    out_shardings=(row2, row2))
+            return jax.jit(step)
+
+        return kernel_cache.get_or_build("knn/ring_step", key, build)
 
     # ------------------------------------- IterativeTransformer protocol
     def initial_state(self, left_xy, right_xy) -> IterationState:
@@ -438,30 +441,43 @@ class SpatialKNN(IterativeTransformer):
         # spatially coherent blocks keep the per-block centering tight
         order = np.lexsort((left_xy[:, 0],
                             np.round(left_xy[:, 1] / 4.0)))
-        key = ("brute", B, m, kc)
-        fn = self._step_cache.get(key)
-        if fn is None:
+
+        def build():
             def kern(lc, rc):
                 dx = lc[:, None, 0] - rc[None, :, 0]
                 dy = lc[:, None, 1] - rc[None, :, 1]
                 negd2, idx = jax.lax.top_k(-(dx * dx + dy * dy), kc)
                 return -negd2, idx
-            fn = jax.jit(kern)
-            self._step_cache[key] = fn
+            # both inputs are per-block scratch — donate them
+            return donate_jit(kern, donate_argnums=(0, 1))
+
+        fn = kernel_cache.get_or_build("knn/brute_topk", (B, m, kc),
+                                       build)
         ids = np.empty((n, kc), np.int64)
         d2s = np.empty((n, kc), np.float64)
         flagged = np.zeros(n, bool)
-        for s in range(0, n, B):
-            rows = order[s:s + B]
+
+        def _center(rows):
             lb = left_xy[rows]
             center = lb.mean(axis=0)
             lc = (lb - center).astype(np.float32)
             rc = (right_xy - center).astype(np.float32)
+            return lb, lc, rc
+
+        def put(rows):
+            _, lc, rc = _center(rows)
             if len(rows) < B:
                 lc = np.pad(lc, ((0, B - len(rows)), (0, 0)))
-            d2b, idxb = fn(jnp.asarray(lc), jnp.asarray(rc))
-            cand = np.asarray(idxb)[:len(rows)].astype(np.int64)
-            c32 = np.asarray(d2b)[:len(rows), -1].astype(np.float64)
+            return jax.device_put((lc, rc))
+
+        def consume(i, rows, host):
+            # worker-thread half of the pipeline: the f64 re-rank of
+            # block i overlaps the device pass on block i+1.  ONE
+            # worker — the writes into ids/d2s/flagged need no locks.
+            d2b, idxb = host
+            lb, lc, rc = _center(rows)
+            cand = idxb[:len(rows)].astype(np.int64)
+            c32 = d2b[:len(rows), -1].astype(np.float64)
             # worst-case f32 d2 error on centered coords: per axis
             # |2*dx*ddx| with |dx| <= 2S, ddx <= eps*S, plus squaring
             # and the add — ~24 eps S^2 total; 32 keeps margin
@@ -478,6 +494,9 @@ class SpatialKNN(IterativeTransformer):
             # inside the f32 candidate horizon
             if kc < m:
                 flagged[rows] = d2s[rows, kk - 1] >= c32 - err
+
+        stream([order[s:s + B] for s in range(0, n, B)],
+               compute=lambda dev: fn(*dev), put=put, consume=consume)
         sel = np.nonzero(flagged)[0]
         if len(sel):
             ids_h, d2_h = _brute_topk_blocked(

@@ -40,6 +40,7 @@ from ..core.geometry.padded import build_edges
 from ..core.index.base import IndexSystem
 from ..core.tessellate import tessellate
 from ..ops.lookup import lookup
+from ..perf.pipeline import chunk_rows, stream
 from ..types import ChipSet
 
 #: f32 hazard band (degrees) around chip edges for the crossing-parity
@@ -240,10 +241,20 @@ def localize(idx: PIPIndex, points64: np.ndarray) -> np.ndarray:
 
 
 def make_pip_join_fn(idx, grid: IndexSystem, eps: Optional[float] = None,
-                     margin_eps: Optional[float] = None):
+                     margin_eps: Optional[float] = None,
+                     precision: str = "auto"):
     """Close the index over a jittable ``local_points -> (zone,
     uncertain)``; inputs come from ``localize`` (local-frame float32).
     Dense indexes dispatch to make_dense_pip_join_fn.
+
+    ``precision`` pins the dense path's projection arithmetic ("f32" /
+    "df" / "f64"; see ``h3.jaxkernel.pick_precision``).  "auto" resolves
+    per backend — note it picks native f64 on CPU whenever
+    ``jax_enable_x64`` is on, which is exact-but-slow; throughput
+    benchmarks that enable x64 for other subsystems should pin the
+    arithmetic they mean to measure.  Exactness does not depend on the
+    choice: wider-error paths raise ``uncertain`` over a wider margin
+    band and the f64 host recheck resolves them.
 
     Exactness contract: every float32 hazard raises ``uncertain``, and
     host_recheck resolves those in float64 — (a) points within ``eps`` of
@@ -255,7 +266,7 @@ def make_pip_join_fn(idx, grid: IndexSystem, eps: Optional[float] = None,
     if isinstance(idx, DensePIPIndex):
         return make_dense_pip_join_fn(
             idx, eps=EPS_EDGE_DEG if eps is None else eps,
-            margin_eps_deg=margin_eps)
+            precision=precision, margin_eps_deg=margin_eps)
     # sorted-path defaults (wider: its f32 absolute-coordinate cell
     # assignment carries more error than the dense path's projection).
     # The margin additionally covers the cell-edge sagitta — the gap
@@ -290,6 +301,61 @@ def make_pip_join_fn(idx, grid: IndexSystem, eps: Optional[float] = None,
         return jnp.where(inb, zone, jnp.int32(-1)), uncertain | near_edge
 
     return fn
+
+
+def make_streamed_pip_join(idx, grid: IndexSystem,
+                           polys: Optional[GeometryArray] = None,
+                           chunk: int = 262_144,
+                           eps: Optional[float] = None,
+                           margin_eps: Optional[float] = None,
+                           precision: str = "auto"):
+    """End-to-end chunked join with transfer/compute/recheck overlap.
+
+    The single-shot path stages the WHOLE point batch on device, runs
+    one launch, then rechecks on host — three serial phases.  This
+    wrapper cuts the batch into ``chunk``-row pieces and runs them
+    through :func:`mosaic_tpu.perf.pipeline.stream`: the localize +
+    upload of chunk N+1 rides along with device compute on chunk N,
+    and the f64 host recheck of chunk N−1 runs on the pipeline's
+    worker thread.  Exactness is untouched — same kernel, same
+    recheck authority (``polys`` is required for a sorted
+    :class:`PIPIndex`, optional for dense).
+
+    Returns ``run(points64_abs) -> (zone [N] int32, rechecked
+    count)``."""
+    fn = jax.jit(make_pip_join_fn(idx, grid, eps, margin_eps, precision))
+    recheck = host_recheck_fn(idx, polys)
+    origin = np.asarray(idx.origin)
+
+    def run(points64: np.ndarray):
+        from ..obs import metrics, tracer
+        from ..obs.context import root_trace
+        points64 = np.asarray(points64, np.float64)[:, :2]
+        n = len(points64)
+        zone_out = np.empty(n, np.int32)
+        state = {"rechecked": 0}
+
+        def put(sl):
+            # f64 origin shift BEFORE the f32 cast (= localize());
+            # device_put is async, so this overlaps the running launch
+            return jax.device_put(np.asarray(
+                points64[sl] - origin[None], np.float32))
+
+        def consume(i, sl, host):
+            z, unc = host
+            zone_out[sl] = recheck(points64[sl], z, unc)
+            state["rechecked"] += int(unc.sum())
+
+        with root_trace("pip_join"), tracer.span("pip_join/streamed"):
+            stream(chunk_rows(n, chunk), compute=fn, put=put,
+                   consume=consume)
+        if metrics.enabled:
+            metrics.count("pip_join/streamed_points", float(n))
+            metrics.count("pip_join/streamed_chunks",
+                          float(-(-n // chunk) if n else 0))
+        return zone_out, state["rechecked"]
+
+    return run
 
 
 # ----------------------------------------------------------- sharded path

@@ -21,26 +21,22 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.raster.tile import RasterTile
+from ..perf.jit_cache import kernel_cache
+from ..perf.pipeline import stream
 
-__all__ = ["sharded_convolve"]
-
-_JIT_CACHE = {}
+__all__ = ["sharded_convolve", "sharded_convolve_stream"]
 
 
-def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
-                     axis: str = "data") -> RasterTile:
-    """rops.convolve over a mesh: row-slab sharding + halo exchange.
-
-    The mesh axis size must divide the tile's height (callers can
-    retile/pad; keeping the constraint explicit avoids silently uneven
-    slabs)."""
+def _convolve_fn(kernel: np.ndarray, mesh, axis: str, shape):
+    """Validate + return the compiled sharded stencil for tiles of
+    ``shape`` = (bands, H, W) (cached in the process kernel cache)."""
     import jax
     import jax.numpy as jnp
     try:
         from jax import shard_map
     except ImportError:      # moved in newer jax; older keeps it here
         from jax.experimental.shard_map import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     k = np.asarray(kernel, np.float64)
     kh, kw = k.shape
@@ -49,15 +45,13 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
                          "(same-shape output)")
     halo = kh // 2
     D = mesh.shape[axis]
-    bands, H, W = tile.data.shape
+    bands, H, W = shape
     if H % D != 0:
         raise ValueError(f"the {axis} axis size {D} must divide the "
                          f"tile height {H} (retile or pad first)")
     if H // D < halo:
         raise ValueError(f"slab height {H // D} smaller than the "
                          f"kernel halo {halo}")
-    data = np.where(tile.valid_mask(),
-                    np.asarray(tile.data, np.float32), 0.0)
     kj = jnp.asarray(k.astype(np.float32))
 
     def local(slab):
@@ -85,22 +79,44 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
     # cache the compiled stencil: a fresh closure per call would
     # retrace + recompile for every same-shaped tile in a pipeline
     key = (id(mesh), axis, D, kh, kw, bands, H, W, k.tobytes())
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(shard_map(
+    return kernel_cache.get_or_build(
+        "raster/halo_convolve", key,
+        lambda: jax.jit(shard_map(
             local, mesh=mesh,
             in_specs=P(None, axis, None),
-            out_specs=P(None, axis, None)))
-        _JIT_CACHE[key] = fn
-    from ..obs import metrics, tracer
-    from ..obs.context import root_trace
+            out_specs=P(None, axis, None))))
+
+
+def _count_halo_bytes(kernel, mesh, axis, shape, n_tiles=1):
+    from ..obs import metrics
     if metrics.enabled:
         # two ppermute shifts move `halo` rows per device each way:
         # bands * halo * W f32 per device per shift, D devices
-        moved = 2.0 * D * bands * halo * W * 4
+        halo = np.asarray(kernel).shape[0] // 2
+        D = mesh.shape[axis]
+        bands, _, W = shape
+        moved = 2.0 * D * bands * halo * W * 4 * n_tiles
         metrics.count("collective/ppermute_bytes", moved)
         metrics.count("collective/ppermute_bytes/raster_halo", moved)
-        metrics.count("collective/ppermute_calls", 2)
+        metrics.count("collective/ppermute_calls", 2 * n_tiles)
+
+
+def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
+                     axis: str = "data") -> RasterTile:
+    """rops.convolve over a mesh: row-slab sharding + halo exchange.
+
+    The mesh axis size must divide the tile's height (callers can
+    retile/pad; keeping the constraint explicit avoids silently uneven
+    slabs)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    fn = _convolve_fn(kernel, mesh, axis, tile.data.shape)
+    data = np.where(tile.valid_mask(),
+                    np.asarray(tile.data, np.float32), 0.0)
+    from ..obs import tracer
+    from ..obs.context import root_trace
+    _count_halo_bytes(kernel, mesh, axis, tile.data.shape)
     arr = jax.device_put(
         jnp.asarray(data),
         NamedSharding(mesh, P(None, axis, None)))
@@ -108,3 +124,43 @@ def sharded_convolve(tile: RasterTile, kernel: np.ndarray, mesh,
         out = np.asarray(fn(arr))
     return RasterTile(out, tile.gt, nodata=None, srid=tile.srid,
                       meta={"op": "convolve", "sharded": "halo"})
+
+
+def sharded_convolve_stream(tiles, kernel: np.ndarray, mesh,
+                            axis: str = "data") -> list:
+    """Convolve MANY same-shaped tiles with upload/compute overlap.
+
+    One compiled stencil serves the whole batch; the double-buffered
+    executor uploads tile N+1 while the collectives run on tile N and
+    fetches tile N-1 on a worker thread (perf.pipeline.stream).
+    Returns the output :class:`RasterTile` list in input order."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tiles = list(tiles)
+    if not tiles:
+        return []
+    shape = tiles[0].data.shape
+    for t in tiles[1:]:
+        if t.data.shape != shape:
+            raise ValueError(
+                f"sharded_convolve_stream needs same-shaped tiles "
+                f"(got {t.data.shape} after {shape}); group by shape "
+                "first")
+    fn = _convolve_fn(kernel, mesh, axis, shape)
+    _count_halo_bytes(kernel, mesh, axis, shape, n_tiles=len(tiles))
+    sharding = NamedSharding(mesh, P(None, axis, None))
+
+    def put(tile):
+        data = np.where(tile.valid_mask(),
+                        np.asarray(tile.data, np.float32), 0.0)
+        return jax.device_put(jnp.asarray(data), sharding)
+
+    def consume(i, tile, host):
+        return RasterTile(host, tile.gt, nodata=None, srid=tile.srid,
+                          meta={"op": "convolve", "sharded": "halo"})
+
+    from ..obs import tracer
+    from ..obs.context import root_trace
+    with root_trace("raster_halo"), tracer.span("halo/convolve_stream"):
+        return stream(tiles, compute=fn, put=put, consume=consume)

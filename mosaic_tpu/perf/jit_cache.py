@@ -1,0 +1,165 @@
+"""Process-level compiled-kernel cache + persistent compilation cache.
+
+Two layers, addressing two different compile costs:
+
+* :class:`JitCache` — an LRU of ``jax.jit``-wrapped callables keyed on
+  ``(kernel name, shape/dtype/static-arg key)``.  It unifies the
+  ad-hoc module/instance ``dict`` caches that had accumulated in
+  ``core/tessellate.py`` (``_PARITY_JIT``/``_CLIP_JIT``),
+  ``models/knn.py`` (``SpatialKNN._step_cache``) and
+  ``parallel/raster_halo.py`` (``_JIT_CACHE``) — one bounded cache,
+  one eviction policy, one set of hit/miss/eviction counters in
+  ``obs.metrics`` (``perf/jit_cache/hit|miss|evict`` plus per-kernel
+  ``.../miss/<name>``).  The counters also accumulate locally so tests
+  can assert on them without enabling the registry.
+* :func:`configure_persistent_cache` — wires JAX's on-disk compilation
+  cache (``jax_compilation_cache_dir``) with thresholds dropped to
+  zero so every entry persists.  A warm-started process then loads
+  compiled executables from disk instead of re-running XLA: the
+  first-call warmup disappears.  NOTE: ``jax.monitoring`` still fires
+  ``backend_compile`` duration events on persistent-cache HITS (the
+  event wraps the lookup), so "did anything actually compile" must be
+  read from the ``jax/cache/cache_misses`` counter
+  (``obs.jaxmon._on_event``), not from ``jax/recompiles`` — the bench
+  record and the CI warm-start assertion both do.
+
+The configuration must be identical and applied BEFORE the first
+compile in every process that shares a cache directory: the cache key
+hashes the compile options, so config drift between runs silently
+turns hits into misses.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from ..obs.metrics import metrics
+
+__all__ = ["JitCache", "kernel_cache", "configure_persistent_cache",
+           "persistent_cache_dir"]
+
+#: env var mirroring the ``mosaic.jit.cache.dir`` conf key
+JIT_CACHE_DIR_ENV = "MOSAIC_TPU_JIT_CACHE_DIR"
+
+
+class JitCache:
+    """Bounded LRU of compiled functions, thread-safe.
+
+    Keys are ``(name, key)`` where ``name`` identifies the kernel
+    builder (a stable string, NOT a function id — ids recycle) and
+    ``key`` captures everything the compiled artifact depends on:
+    padded shapes, dtypes, static arguments, and — for sharded
+    kernels — ``id(mesh)`` (a jitted fn bakes its mesh's shardings).
+    """
+
+    def __init__(self, capacity: int = 256, scope: str = "kernel"):
+        self.capacity = int(capacity)
+        self.scope = scope
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Callable]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, name: str, key,
+                     build: Callable[[], Callable]) -> Callable:
+        """Return the cached callable for ``(name, key)``, building
+        (and caching) it on first use.  ``build`` runs outside the
+        lock-free fast path but inside the miss path's lock — builders
+        are cheap ``jax.jit(...)`` wrappings (compilation itself is
+        lazy, at first call of the returned fn)."""
+        full = (name, key)
+        with self._lock:
+            fn = self._entries.get(full)
+            if fn is not None:
+                self._entries.move_to_end(full)
+                self.hits += 1
+                if metrics.enabled:
+                    metrics.count("perf/jit_cache/hit")
+                return fn
+            fn = build()
+            self._entries[full] = fn
+            self.misses += 1
+            if metrics.enabled:
+                metrics.count("perf/jit_cache/miss")
+                metrics.count(f"perf/jit_cache/miss/{name}")
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                if metrics.enabled:
+                    metrics.count("perf/jit_cache/evict")
+        return fn
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "size": len(self._entries)}
+
+
+#: the process-global kernel cache every bucketed kernel goes through
+kernel_cache = JitCache()
+
+
+_persist_lock = threading.Lock()
+_persist_dir: Optional[str] = None
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory the persistent compilation cache was wired to in
+    this process (None = not configured)."""
+    return _persist_dir
+
+
+def configure_persistent_cache(path: Optional[str] = None
+                               ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path``.
+
+    Resolution order: explicit argument > ``MOSAIC_TPU_JIT_CACHE_DIR``
+    env > the active config's ``mosaic.jit.cache.dir``.  Returns the
+    resolved directory, or None when nothing is configured (a no-op —
+    the in-memory caches still work).  Idempotent; re-pointing at a
+    different directory is honored (last call wins) but logged to the
+    flight recorder either way.
+
+    Thresholds are dropped so EVERY compile persists
+    (``min_entry_size_bytes=-1``, ``min_compile_time_secs=0``): this
+    package's kernels are many and individually fast to compile, and
+    the 1-2 ms disk hit beats even the cheapest recompile.  Call this
+    before the first compile with the SAME settings in every process
+    sharing the directory — the cache key hashes compile options, so
+    drift turns hits into misses."""
+    global _persist_dir
+    if path is None:
+        path = os.environ.get(JIT_CACHE_DIR_ENV)
+    if path is None:
+        from ..config import default_config
+        path = getattr(default_config(), "jit_cache_dir", "") or None
+    if not path:
+        return _persist_dir
+    path = str(path)
+    with _persist_lock:
+        if _persist_dir == path:
+            return _persist_dir
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        _persist_dir = path
+    from ..obs.recorder import recorder
+    recorder.record("config", key="mosaic.jit.cache.dir", value=path)
+    return _persist_dir
