@@ -42,6 +42,12 @@ MOSAIC_IO_ON_ERROR = "mosaic.io.on.error"
 # empty (the default) leaves the on-disk cache unconfigured.  Env var
 # MOSAIC_TPU_JIT_CACHE_DIR takes precedence over this key.
 MOSAIC_JIT_CACHE_DIR = "mosaic.jit.cache.dir"
+# Cadence (in calls/chunks) of the sharded join's per-shard skew
+# readback and placement refresh (parallel/pip_join.py,
+# parallel/placement.py): every K-th call syncs the matched-candidate
+# counts per shard, records the shard/skew/* gauges + time series, and
+# feeds the skew-aware placement pass.
+MOSAIC_SHARD_SKEW_REFRESH = "mosaic.shard.skew.refresh"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -92,6 +98,10 @@ class MosaicConfig:
     # set (here or via MOSAIC_TPU_JIT_CACHE_DIR), warm-started
     # processes load XLA executables from disk instead of recompiling.
     jit_cache_dir: str = ""
+    # Every K-th sharded-join call/chunk reads back per-shard matched
+    # counts (one host sync), records shard/skew/* and refreshes the
+    # skew-aware placement.  Smaller = fresher placement, more syncs.
+    shard_skew_refresh: int = 16
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -177,6 +187,7 @@ _CONF_FIELDS = {
     MOSAIC_CRS_STRICT_DATUM: ("crs_strict_datum", _as_flag),
     MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
     MOSAIC_JIT_CACHE_DIR: ("jit_cache_dir", _as_str),
+    MOSAIC_SHARD_SKEW_REFRESH: ("shard_skew_refresh", _as_blocksize),
 }
 
 

@@ -50,6 +50,10 @@ class MosaicContext(RasterFunctions):
         self.config = MosaicConfig(
             index_system=getattr(self.index_system, "name", "H3"),
             geometry_api=geometry_api)
+        # device mesh for the sharded operator family (use_mesh());
+        # None = single-device execution everywhere
+        self.mesh = None
+        self.mesh_axis = "data"
 
     # reference: MosaicContext.build (functions/MosaicContext.scala:1110)
     @classmethod
@@ -92,6 +96,18 @@ class MosaicContext(RasterFunctions):
             return getattr(self, name)(*args, **kwargs)
         with tracer.span(f"call/{name}"):
             return getattr(self, name)(*args, **kwargs)
+
+    def use_mesh(self, mesh, axis: str = "data") -> "MosaicContext":
+        """Bind a ``jax.sharding.Mesh`` so mesh-aware operators (the
+        sharded overlay/join family, e.g. ``grid_intersects_sharded``)
+        distribute over it — their collective accounting
+        (``collective/all_to_all_bytes``, ``shard/skew/*``) then
+        surfaces in SQL ``EXPLAIN ANALYZE`` operator rows.  Pass
+        ``None`` to return to single-device execution.  Returns self
+        (chainable)."""
+        self.mesh = mesh
+        self.mesh_axis = axis
+        return self
 
     def try_sql(self, fn, *args, **kwargs):
         """Null-on-error wrapper (reference:
@@ -735,6 +751,22 @@ class MosaicContext(RasterFunctions):
     def grid_tessellate(self, g: Geoms, res: int,
                         keep_core_geom: bool = True) -> ChipSet:
         return tessellate(g, res, self.index_system, keep_core_geom)
+
+    def grid_intersects_sharded(self, a: Geoms, b: Geoms,
+                                res: int) -> np.ndarray:
+        """Row-wise exact ST_Intersects via the distributed
+        chip-exchange overlay (parallel/overlay.py): both sides
+        tessellate at ``res``, chips hash-exchange across the bound
+        mesh (:meth:`use_mesh`), and pairwise segment/containment
+        tests run where the cells land.  With no mesh bound it runs
+        the same overlay on one device.  The sharded run populates the
+        collective accounting (``collective/all_to_all_bytes``,
+        ``shard/skew/overlay``) that EXPLAIN ANALYZE attributes to the
+        operator row driving this call."""
+        from ..parallel.overlay import overlay_intersects
+        hits = overlay_intersects(a, b, int(res), self.index_system,
+                                  mesh=self.mesh, axis=self.mesh_axis)
+        return np.diagonal(np.asarray(hits)).copy()
 
     grid_tessellateexplode = grid_tessellate
     mosaic_explode = grid_tessellate          # legacy alias (:549-557)

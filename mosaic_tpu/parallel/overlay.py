@@ -152,8 +152,11 @@ def _account_exchange(site: str, D: int, bucket_cap: int, cap_e: int,
         counts = np.bincount(_hash_dest_np(np.asarray(cells)[v], D),
                              minlength=D)
         mean = float(counts.mean())
-        metrics.gauge(f"shard/skew/{site}",
-                      float(counts.max()) / mean if mean else 1.0)
+        skew = float(counts.max()) / mean if mean else 1.0
+        metrics.gauge(f"shard/skew/{site}", skew)
+        # also a distribution so repeated exchanges build a time
+        # series (p50/p95/p99), not just a last-value gauge
+        metrics.observe(f"shard/skew_series/{site}", skew)
         metrics.gauge(f"shard/rows_max/{site}", float(counts.max()))
 
 

@@ -360,6 +360,30 @@ def make_streamed_pip_join(idx, grid: IndexSystem,
 
 # ----------------------------------------------------------- sharded path
 
+#: padding rows in the sharded streamed path get this local-frame
+#: coordinate (degrees): far outside every workload extent, so both
+#: index paths resolve them to zone -1 without tripping the f64
+#: recheck, yet small enough that f32 trig in the projections stays
+#: finite (1e9-style sentinels risk inf/nan there)
+_PAD_SENTINEL_DEG = 4.0e3
+
+
+def _shard_skew_readback(zones_padded: np.ndarray, D: int):
+    """Per-shard matched-candidate counts from a [D*rows] zone vector
+    (padding rows read zone -1 and drop out).  Records the skew gauge,
+    its time series, and the max-candidates gauge."""
+    from ..obs import metrics
+    c = (zones_padded.reshape(D, -1) >= 0).sum(axis=1)
+    mean = float(c.mean())
+    skew = float(c.max()) / mean if mean else 1.0
+    metrics.gauge("shard/skew/pip_join", skew)
+    # same quantity as a distribution: shard/skew_series/pip_join_p50/
+    # p95/p99 expose how imbalance evolves, not just the last readback
+    metrics.observe("shard/skew_series/pip_join", skew)
+    metrics.gauge("shard/candidates_max/pip_join", float(c.max()))
+    return c
+
+
 def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
                           eps: Optional[float] = None,
                           margin_eps: Optional[float] = None,
@@ -367,29 +391,40 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
     """The multi-chip join: points shard over ``axis``, the index
     replicates (the reference's broadcast-join regime, SURVEY.md P2).
 
-    Returns a jitted fn points[N,2] -> (zone [N], uncertain [N]) with N
+    Returns a fn points[N,2] -> (zone [N], uncertain [N]) with N
     divisible by the mesh axis size.  Collectives only appear in
-    aggregations layered on top (see zone_histogram).
+    aggregations layered on top (see zone_histogram).  The jitted
+    kernel lives in ``perf.jit_cache.kernel_cache`` (the cached entry
+    closes over ``idx``/``mesh``, pinning both ids for the entry's
+    lifetime), so rebuilding the wrapper for the same index+mesh costs
+    a dict hit, not a retrace.
 
     Observability: with the metrics registry enabled, the wrapper
     records the replicated-index footprint (the broadcast-join's data
-    movement: every device holds the whole index) and, on the first
-    call only, the per-shard matched-candidate skew (max/mean of
-    zone >= 0 counts per shard — reading it back every call would put a
-    host sync on the hot path)."""
+    movement: every device holds the whole index) and, every
+    ``mosaic.shard.skew.refresh``-th call (default 16 — each readback
+    is a host sync on the hot path), the per-shard matched-candidate
+    skew (max/mean of zone >= 0 counts per shard) as both the
+    ``shard/skew/pip_join`` gauge and the ``shard/skew_series``
+    distribution.  For the skew-aware streamed composition see
+    :func:`make_sharded_streamed_pip_join`."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..config import default_config
     from ..obs import metrics
+    from ..perf.jit_cache import kernel_cache
 
     fn = make_pip_join_fn(idx, grid, eps, margin_eps)
     pts_sharding = NamedSharding(mesh, P(axis, None))
     out_sharding = (NamedSharding(mesh, P(axis)),
                     NamedSharding(mesh, P(axis)))
-    jfn = jax.jit(fn, in_shardings=(pts_sharding,),
-                  out_shardings=out_sharding)
+    jfn = kernel_cache.get_or_build(
+        "pip/sharded_wrap", (id(idx), id(mesh), axis, eps, margin_eps),
+        lambda: jax.jit(fn, in_shardings=(pts_sharding,),
+                        out_shardings=out_sharding))
     D = mesh.shape[axis]
     idx_bytes = sum(int(np.asarray(leaf).nbytes)
                     for leaf in jax.tree_util.tree_leaves(idx))
-    state = {"first": True}
+    state = {"calls": 0}
 
     def wrapped(points):
         from ..obs import tracer
@@ -403,20 +438,143 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
             metrics.count("collective/points_scatter_bytes",
                           float(points.size) * points.dtype.itemsize)
             metrics.gauge("shard/points_per_shard/pip_join", n / D)
-            if state["first"]:
-                state["first"] = False
-                metrics.count("collective/broadcast_bytes",
-                              float(idx_bytes) * max(D - 1, 1))
-                zones = np.asarray(out[0]).reshape(D, -1)
-                c = (zones >= 0).sum(axis=1)
-                mean = float(c.mean())
-                metrics.gauge("shard/skew/pip_join",
-                              float(c.max()) / mean if mean else 1.0)
-                metrics.gauge("shard/candidates_max/pip_join",
-                              float(c.max()))
+            k = max(1, default_config().shard_skew_refresh)
+            if state["calls"] % k == 0:
+                if state["calls"] == 0:
+                    metrics.count("collective/broadcast_bytes",
+                                  float(idx_bytes) * max(D - 1, 1))
+                _shard_skew_readback(np.asarray(out[0]), D)
+            state["calls"] += 1
         return out
 
     return wrapped
+
+
+def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
+                                   polys: Optional[GeometryArray] = None,
+                                   chunk: int = 262_144,
+                                   eps: Optional[float] = None,
+                                   margin_eps: Optional[float] = None,
+                                   axis: str = "data",
+                                   refresh: Optional[int] = None,
+                                   nbins: int = 16):
+    """The sharded flagship: :func:`make_streamed_pip_join` composed
+    with the mesh.  One pipeline, three layers of the perf stack:
+
+    * **double-buffered staging** — chunks flow through
+      ``perf.pipeline.stream``: the scatter (host device_put of chunk
+      N+1, split across the mesh by ``NamedSharding``) overlaps the
+      sharded compute on chunk N, and the f64 recheck of chunk N−1
+      drains on the pipeline's worker thread.
+    * **bucketed kernel cache** — each chunk pads (sentinel rows, zone
+      −1 by construction) to ``pow2_bucket(rows / D) * D`` and the
+      jitted sharded kernel is keyed into
+      ``perf.jit_cache.kernel_cache`` per (index, mesh, bucket): one
+      XLA compile per bucket per mesh shape, zero in a warm process
+      (asserted by the multichip-smoke CI lane).
+    * **skew-aware placement** — a :class:`.placement.SkewRebalancer`
+      learns per-grid-cell matched-candidate density from every
+      consumed chunk (free: the zones are already on host) and, every
+      ``refresh`` chunks (``mosaic.shard.skew.refresh``, default 16),
+      greedily re-packs cells onto shards; rows then scatter to
+      per-shard slots via :func:`.placement.placement_slots` instead
+      of arrival order.  The inverse permutation is applied on the
+      host gather, so results are bit-for-bit identical to the
+      single-device streamed path — placement only moves *where* each
+      row is computed.
+
+    ``polys`` is required for a sorted :class:`PIPIndex` (recheck
+    authority), optional for dense.  Returns ``run(points64_abs) ->
+    (zone [N] int32, rechecked count)``; ``run.rebalancer`` exposes
+    the placement pass for inspection."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..config import default_config
+    from ..obs import metrics
+    from ..perf.bucketing import pow2_bucket
+    from ..perf.jit_cache import kernel_cache
+    from .placement import SkewRebalancer, placement_slots
+
+    fn = make_pip_join_fn(idx, grid, eps, margin_eps)
+    recheck = host_recheck_fn(idx, polys)
+    origin = np.asarray(idx.origin)
+    D = mesh.shape[axis]
+    pts_sharding = NamedSharding(mesh, P(axis, None))
+    out_sharding = (NamedSharding(mesh, P(axis)),
+                    NamedSharding(mesh, P(axis)))
+    if refresh is None:
+        refresh = default_config().shard_skew_refresh
+    rebalancer = SkewRebalancer(D, refresh=refresh, nbins=nbins)
+    idx_bytes = sum(int(np.asarray(leaf).nbytes)
+                    for leaf in jax.tree_util.tree_leaves(idx))
+
+    def kernel(rows):
+        # one jit wrapper per padded bucket per mesh; the entry closes
+        # over idx and the mesh-bound shardings, pinning both ids
+        return kernel_cache.get_or_build(
+            "pip/sharded_stream",
+            (id(idx), id(mesh), axis, rows, eps, margin_eps),
+            lambda: jax.jit(fn, in_shardings=(pts_sharding,),
+                            out_shardings=out_sharding))
+
+    def run(points64: np.ndarray):
+        from ..obs import tracer
+        from ..obs.context import root_trace
+        points64 = np.asarray(points64, np.float64)[:, :2]
+        n = len(points64)
+        zone_out = np.empty(n, np.int32)
+        state = {"rechecked": 0, "slots": {}}
+
+        def put(sl):
+            rows = sl.stop - sl.start
+            per = pow2_bucket(-(-rows // D), floor=64)
+            pref = rebalancer.preferred(points64[sl])
+            slots = placement_slots(pref, rows, D, per)
+            buf = np.full((per * D, 2), _PAD_SENTINEL_DEG, np.float32)
+            # f64 origin shift BEFORE the f32 cast (= localize()), same
+            # values as the single-device put — only the row order and
+            # padding differ
+            buf[slots] = (points64[sl] - origin[None]).astype(np.float32)
+            state["slots"][sl.start] = slots
+            # device_put against the sharding splits the buffer across
+            # the mesh asynchronously, overlapping the running launch
+            return per * D, jax.device_put(buf, pts_sharding)
+
+        def compute(staged):
+            rows, dev = staged
+            return kernel(rows)(dev)
+
+        def consume(i, sl, host):
+            zp, up = host
+            zp = np.asarray(zp)
+            slots = state["slots"].pop(sl.start)
+            z = zp[slots]
+            unc = np.asarray(up)[slots]
+            zone_out[sl] = recheck(points64[sl], z, unc)
+            state["rechecked"] += int(unc.sum())
+            # feedback is free here — the shard results are already on
+            # host, unlike the monolithic path's cadenced device sync
+            rebalancer.observe(points64[sl], z >= 0)
+            if metrics.enabled:
+                _shard_skew_readback(zp, D)
+                metrics.gauge("shard/skew_planned/pip_join",
+                              rebalancer.planned_skew())
+
+        with root_trace("pip_join"), \
+                tracer.span("pip_join/sharded_streamed"):
+            stream(chunk_rows(n, chunk), compute=compute, put=put,
+                   consume=consume)
+        if metrics.enabled:
+            metrics.gauge("collective/replicated_index_bytes",
+                          float(idx_bytes) * D)
+            metrics.gauge("shard/points_per_shard/pip_join", n / D)
+            metrics.count("collective/points_scatter_bytes", 8.0 * n)
+            metrics.count("pip_join/sharded_points", float(n))
+            metrics.count("pip_join/sharded_chunks",
+                          float(-(-n // chunk) if n else 0))
+        return zone_out, state["rechecked"]
+
+    run.rebalancer = rebalancer
+    return run
 
 
 def zone_histogram(zone: jnp.ndarray, num_zones: int) -> jnp.ndarray:

@@ -31,8 +31,16 @@ from ..obs import metrics
 from ..obs.recorder import recorder
 from . import faults
 
-__all__ = ["RetryPolicy", "retrying", "CHECKPOINT_RETRY",
-           "NATIVE_COMPILE_RETRY", "NATIVE_LOAD_RETRY"]
+__all__ = ["RetryPolicy", "retrying", "ProbeFailure",
+           "CHECKPOINT_RETRY", "NATIVE_COMPILE_RETRY",
+           "NATIVE_LOAD_RETRY", "BENCH_PROBE_RETRY"]
+
+
+class ProbeFailure(RuntimeError):
+    """One out-of-process backend probe attempt failed (nonzero exit
+    or hung subprocess).  Raised by bench.py's TPU probe so the
+    BENCH_PROBE_RETRY allowlist can name a type narrower than
+    SubprocessError."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,3 +139,13 @@ NATIVE_COMPILE_RETRY = RetryPolicy(
 NATIVE_LOAD_RETRY = RetryPolicy(name="native.load", max_attempts=2,
                                 base_delay_s=0.0, jitter=0.0,
                                 retry_on=(OSError,))
+
+#: bench.py's out-of-process TPU probe: a down tunnel HANGS
+#: jax.devices(), so each attempt is subprocess+timeout and the policy
+#: bounds the retries (backoff 10s -> 30s max; retry/* counters +
+#: flight-recorder events replace the old hand-rolled sleep loop)
+BENCH_PROBE_RETRY = RetryPolicy(name="bench.probe", max_attempts=3,
+                                base_delay_s=10.0, max_delay_s=30.0,
+                                multiplier=2.0,
+                                retry_on=(ProbeFailure, OSError,
+                                          _subprocess.SubprocessError))

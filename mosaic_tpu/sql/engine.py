@@ -239,12 +239,20 @@ class SQLSession:
         if q.explain == "analyze":
             prof: List[tuple] = []
             self._execute(q, prof)
+            # all_to_all_bytes / shard_skew attribute the sharded
+            # exchange (parallel/overlay collective accounting) to the
+            # operator row that moved the bytes — zero rows mean the
+            # operator never left one device
             return Table({"operator": [p[0] for p in prof],
                           "detail": [p[1] for p in prof],
                           "rows": np.asarray([p[2] for p in prof],
                                              np.int64),
                           "time_ms": np.asarray([p[3] * 1e3
-                                                 for p in prof])})
+                                                 for p in prof]),
+                          "all_to_all_bytes": np.asarray(
+                              [p[4] for p in prof], np.int64),
+                          "shard_skew": np.asarray(
+                              [p[5] for p in prof])})
         return self._execute(q, None)
 
     def _plan_ops(self, q: Query) -> List[tuple]:
@@ -273,16 +281,30 @@ class SQLSession:
             ops.append(("limit", str(q.limit)))
         return ops
 
+    #: skew-gauge sites the profiler checks when a stage moved
+    #: all_to_all bytes (parallel/{overlay,pip_join} accounting)
+    _SKEW_SITES = ("overlay", "overlay_pairs", "pip_join")
+
     def _execute(self, q: Query, prof: Optional[List[tuple]]) -> Table:
         def stage(op: str, detail: str, fn, rows_of):
             # nested under the sql/query root span -> qualified as
             # sql/query/<op>, a child in the query's trace tree
+            a2a0 = metrics.counter_value("collective/all_to_all_bytes")
             with tracer.span(op):
                 t0 = time.perf_counter()
                 res = fn()
                 dt = time.perf_counter() - t0
             if prof is not None:
-                prof.append((op, detail, rows_of(res), dt))
+                # bytes this stage pushed through sharded exchanges;
+                # when nonzero, the current shard/skew/* gauges were
+                # (re)written by those exchanges, so snapshot the worst
+                a2a = metrics.counter_value(
+                    "collective/all_to_all_bytes") - a2a0
+                skew = max((metrics.gauge_value(f"shard/skew/{s}")
+                            or 0.0)
+                           for s in self._SKEW_SITES) if a2a else 0.0
+                prof.append((op, detail, rows_of(res), dt,
+                             int(a2a), float(skew)))
             if metrics.enabled:
                 metrics.observe(f"sql/{op}_s", dt)
             return res

@@ -370,3 +370,38 @@ def test_explain_and_explain_analyze(session):
     assert "aggregate" in aops and "project" not in aops
     arows = dict(zip(aops, agg.columns["rows"].tolist()))
     assert arows["aggregate"] == 4
+    # single-device queries carry the sharded columns as zeros
+    assert agg.columns["all_to_all_bytes"].tolist() == [0, 0]
+    assert agg.columns["shard_skew"].tolist() == [0.0, 0.0]
+
+
+def test_explain_analyze_sharded_columns(session, mc):
+    """Queries that hit the sharded path (a mesh bound via use_mesh +
+    the distributed chip-exchange overlay) surface per-shard skew and
+    all_to_all bytes on the operator row that moved them."""
+    import jax
+    from mosaic_tpu.obs import metrics
+    session.create_table("shpairs", {"ga": _zones(), "gb": _zones()})
+    was = metrics.enabled
+    metrics.enable()
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
+    try:
+        mc.use_mesh(mesh)
+        out = session.sql(
+            "EXPLAIN ANALYZE SELECT grid_intersects_sharded(ga, gb, 2) "
+            "AS hit FROM shpairs")
+        by_op = {out.columns["operator"][i]: i for i in range(len(out))}
+        proj, scan = by_op["project"], by_op["scan"]
+        # the projection drove the exchange; the scan moved nothing
+        assert out.columns["all_to_all_bytes"][proj] > 0
+        assert out.columns["shard_skew"][proj] >= 1.0
+        assert out.columns["all_to_all_bytes"][scan] == 0
+        assert out.columns["shard_skew"][scan] == 0.0
+        # and the distributed operator still computes the right answer
+        res = session.sql("SELECT grid_intersects_sharded(ga, gb, 2) "
+                          "AS hit FROM shpairs")
+        assert np.asarray(res.columns["hit"]).tolist() == [True, True]
+    finally:
+        mc.use_mesh(None)
+        if not was:
+            metrics.disable()
