@@ -212,6 +212,7 @@ def perf_guard(current: dict, platform: str, slip: float = 0.20,
         return []
     tags = "+".join(tag for tag, _ in hist)
     lower_better = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
+                    "planner_flagship_ms",
                     "sharded_end_to_end_ms",
                     "tessellate_zones_s",
                     "tessellate_counties_s", "overlay_s",
@@ -519,6 +520,66 @@ def main():
         f" (scatter) + broadcast "
         f"{metrics.counter_value('collective/broadcast_bytes'):.0f}")
 
+    # ------------------------------ planner A/B crossover sweep
+    # Same workload at small/medium/large point counts through the
+    # cost-based planner (sql/planner.py) vs. the fixed default path
+    # (streamed join at the bench chunk).  calibrate() first runs
+    # every candidate once — the crossover is then planned from
+    # MEASURED per-size-class coefficients, and each candidate is
+    # parity-checked against the reference path.  Results must be
+    # bit-for-bit identical on or off; the planner only buys speed
+    # (small sizes skip streaming setup via the monolithic launch,
+    # large sizes keep the best streamed chunk class).
+    from mosaic_tpu import config as _config
+    from mosaic_tpu.parallel.pip_join import make_planned_pip_join
+    from mosaic_tpu.sql.planner import planner as _planner
+    _config.set_default_config(_config.apply_conf(
+        _config.default_config(), "mosaic.stream.chunk.rows", chunk))
+    sweep_sizes = [("small", 1 << 11), ("medium", 1 << 13),
+                   ("large", 1 << 15)] if smoke else \
+                  [("small", 1 << 14), ("medium", 1 << 17),
+                   ("large", 1 << 20)]
+    pjoin = make_planned_pip_join(idx, grid, polys=polys)
+    off_join = make_streamed_pip_join(idx, grid, polys=polys,
+                                      chunk=chunk)
+    sweep = []
+    planner_large_ms = None
+    with tracer.span("bench/planner_sweep"):
+        for slabel, sn in sweep_sizes:
+            spts = nyc_points(sn, seed=500 + sn % 97)
+            pjoin.calibrate(spts)   # seed coefficients + parity-check
+            off_join(spts)          # warm the off path at this shape
+            on_times, off_times = [], []
+            z_on = z_off = None
+            for _ in range(3):
+                t0 = time.time()
+                z_on, _ = pjoin(spts)
+                on_times.append(time.time() - t0)
+                t0 = time.time()
+                z_off, _ = off_join(spts)
+                off_times.append(time.time() - t0)
+            par = int(np.sum(np.asarray(z_on) != np.asarray(z_off)))
+            on_ms = float(np.median(on_times)) * 1e3
+            off_ms = float(np.median(off_times)) * 1e3
+            d = pjoin.last_decision
+            sweep.append({
+                "size": slabel, "n": sn,
+                "planner_on_ms": round(on_ms, 2),
+                "planner_off_ms": round(off_ms, 2),
+                "speedup": round(off_ms / on_ms, 3) if on_ms else None,
+                "strategy": d.strategy if d else None,
+                "reason": d.reason if d else None,
+                "parity_mismatches": par})
+            if slabel == "large":
+                planner_large_ms = on_ms
+            log(f"planner sweep {slabel} n={sn}: on {on_ms:.2f} ms "
+                f"({d.strategy if d else '?'}) vs off {off_ms:.2f} ms"
+                f"; parity {par}")
+    planner_rep = _planner.report()
+    log(f"planner: {planner_rep['decisions']} decisions, "
+        f"{planner_rep['mispredicts']} mispredicts, estimate-error "
+        f"p95 {planner_rep['estimate_error_p95']}")
+
     obs_rep = tracer.report()
     p95_ms = round(obs_rep["spans"]
                    .get("bench/flagship_join", {})
@@ -548,6 +609,12 @@ def main():
         "sharded_vs_single_speedup": round(sh_pps / pps, 3),
         "sharded_skew": round(sh_skew, 4),
         "probe_fallback_reason": PROBE_FALLBACK_REASON,
+        # cost-based planner A/B (decisions/mispredicts/estimate-error
+        # come from the planner's own counters, sweep from the timed
+        # crossover above); planner_flagship_ms joins the perf guard
+        "planner": dict(planner_rep, sweep=sweep),
+        "planner_flagship_ms": round(planner_large_ms, 2)
+        if planner_large_ms else None,
         "multichip": {
             "n_devices": len(devs),
             "rc": 0,

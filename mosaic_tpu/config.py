@@ -48,6 +48,21 @@ MOSAIC_JIT_CACHE_DIR = "mosaic.jit.cache.dir"
 # counts per shard, records the shard/skew/* gauges + time series, and
 # feeds the skew-aware placement pass.
 MOSAIC_SHARD_SKEW_REFRESH = "mosaic.shard.skew.refresh"
+# Cost-based planner switches (sql/planner.py).  The planner is pure
+# strategy selection — results are bit-for-bit identical either way —
+# so `enabled` defaults on; force keys pin one operator's strategy
+# ("mosaic.planner.force.pip_join" = "streamed", say) for debugging
+# or pathological workloads.
+MOSAIC_PLANNER_ENABLED = "mosaic.planner.enabled"
+MOSAIC_PLANNER_STATS_PATH = "mosaic.planner.stats.path"
+MOSAIC_PLANNER_FORCE_PREFIX = "mosaic.planner.force."
+# Streamed-executor chunk rows (parallel/pip_join.py double-buffered
+# pipeline; previously a hard-coded 262_144 at every call site) and
+# the KNN strategy ("auto" lets the planner choose brute vs. ring,
+# "brute"/"ring" pin it, a positive integer overrides the
+# brute-right-max row threshold; models/knn.py).
+MOSAIC_STREAM_CHUNK_ROWS = "mosaic.stream.chunk.rows"
+MOSAIC_KNN_STRATEGY = "mosaic.knn.strategy"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -102,6 +117,21 @@ class MosaicConfig:
     # counts (one host sync), records shard/skew/* and refreshes the
     # skew-aware placement.  Smaller = fresher placement, more syncs.
     shard_skew_refresh: int = 16
+    # Cost-based planner (sql/planner.py): per-query strategy choice
+    # from observed stats.  Pure strategy transform — turning it off
+    # changes speed, never results.
+    planner_enabled: bool = True
+    # Persisted learned-coefficient file; "" keeps stats in-process
+    # only.  Env var MOSAIC_TPU_PLANNER_STATS takes precedence.
+    planner_stats_path: str = ""
+    # ((op, strategy), ...) pins from mosaic.planner.force.<op> keys;
+    # ops/strategies validated against planner.FORCE_CHOICES.
+    planner_force: tuple = ()
+    # Rows per streamed-executor chunk (double-buffered device
+    # pipeline); also the planner's monolithic-vs-streamed pivot.
+    stream_chunk_rows: int = 262_144
+    # "auto" | "brute" | "ring" | positive-int brute-right-max.
+    knn_strategy: str = "auto"
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -112,7 +142,8 @@ class MosaicConfig:
         (reference behaviour: Spark confs are an open namespace)."""
         cfg = MosaicConfig()
         for key in confs:
-            if key in _CONF_FIELDS:
+            if key in _CONF_FIELDS or \
+                    key.startswith(MOSAIC_PLANNER_FORCE_PREFIX):
                 cfg = apply_conf(cfg, key, confs[key])
         return cfg
 
@@ -171,6 +202,21 @@ def _as_str(key: str, value) -> str:
     return str(value)
 
 
+def _as_knn_strategy(key: str, value) -> str:
+    s = str(value).strip().lower()
+    if s in ("auto", "brute", "ring"):
+        return s
+    try:
+        n = int(s)
+    except ValueError:
+        raise ConfigError(
+            f"{key}={value!r} invalid (auto, brute, ring, or a "
+            "positive integer brute-right-max threshold)") from None
+    if n <= 0:
+        raise ConfigError(f"{key}={n} threshold must be positive")
+    return str(n)
+
+
 #: conf key -> (dataclass field, validating coercer)
 _CONF_FIELDS = {
     MOSAIC_INDEX_SYSTEM: ("index_system", _as_str),
@@ -188,7 +234,40 @@ _CONF_FIELDS = {
     MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
     MOSAIC_JIT_CACHE_DIR: ("jit_cache_dir", _as_str),
     MOSAIC_SHARD_SKEW_REFRESH: ("shard_skew_refresh", _as_blocksize),
+    MOSAIC_PLANNER_ENABLED: ("planner_enabled", _as_flag),
+    MOSAIC_PLANNER_STATS_PATH: ("planner_stats_path", _as_str),
+    MOSAIC_STREAM_CHUNK_ROWS: ("stream_chunk_rows", _as_blocksize),
+    MOSAIC_KNN_STRATEGY: ("knn_strategy", _as_knn_strategy),
 }
+
+
+def _apply_planner_force(cfg: MosaicConfig, key: str,
+                         value) -> MosaicConfig:
+    """``mosaic.planner.force.<op>`` assignment: validate op and
+    strategy against the planner's registry, "auto" clears the pin."""
+    from .sql.planner import FORCE_CHOICES
+    op = key[len(MOSAIC_PLANNER_FORCE_PREFIX):]
+    if op not in FORCE_CHOICES:
+        raise ConfigError(
+            f"{key!r}: unknown plannable op {op!r} (known: "
+            f"{', '.join(sorted(FORCE_CHOICES))})")
+    s = str(value).strip().lower()
+    if s not in FORCE_CHOICES[op]:
+        raise ConfigError(
+            f"{key}={value!r} invalid "
+            f"({', '.join(FORCE_CHOICES[op])})")
+    force = tuple((o, st) for o, st in cfg.planner_force if o != op)
+    if s != "auto":
+        force = force + ((op, s),)
+    return dataclasses.replace(cfg, planner_force=force)
+
+
+def planner_force_for(cfg: MosaicConfig, op: str) -> str:
+    """The pinned strategy for ``op`` ("auto" when unpinned)."""
+    for o, s in getattr(cfg, "planner_force", ()):
+        if o == op:
+            return s
+    return "auto"
 
 
 def apply_conf(cfg: MosaicConfig, key: str, value) -> MosaicConfig:
@@ -197,10 +276,16 @@ def apply_conf(cfg: MosaicConfig, key: str, value) -> MosaicConfig:
     Unlike :meth:`MosaicConfig.from_confs` (open namespace), a key this
     build does not know raises — this is the ``SET`` statement /
     programmatic path where a typo should not vanish silently."""
+    if key.startswith(MOSAIC_PLANNER_FORCE_PREFIX):
+        new = _apply_planner_force(cfg, key, value)
+        from .obs.recorder import recorder
+        recorder.record("config", key=key, value=str(value))
+        return new
     if key not in _CONF_FIELDS:
         raise ConfigError(
             f"unknown conf key {key!r} (known: "
-            f"{', '.join(sorted(_CONF_FIELDS))})")
+            f"{', '.join(sorted(_CONF_FIELDS))} and "
+            f"{MOSAIC_PLANNER_FORCE_PREFIX}<op>)")
     field, coerce = _CONF_FIELDS[key]
     coerced = coerce(key, value)
     # config mutations are flight-recorder events: a post-mortem bundle
@@ -225,6 +310,9 @@ def set_default_config(cfg: MosaicConfig) -> None:
     if cfg.jit_cache_dir:
         from .perf.jit_cache import configure_persistent_cache
         configure_persistent_cache(cfg.jit_cache_dir)
+    if cfg.planner_stats_path:
+        from .sql.planner import planner
+        planner.configure_stats(cfg.planner_stats_path)
 
 
 def default_config() -> MosaicConfig:

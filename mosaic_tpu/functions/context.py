@@ -87,15 +87,37 @@ class MosaicContext(RasterFunctions):
         function is reachable by name)."""
         from .registry import REGISTRY
         from ..obs import tracer
+        from ..sql.planner import planner
         if name not in REGISTRY:
             raise ValueError(f"unknown function {name!r} (see "
                              "function_names())")
         # disabled tracer = one attribute check; the span (and its
         # f-string) only exists when someone is watching
+        if not planner.enabled:
+            if not tracer.enabled:
+                return getattr(self, name)(*args, **kwargs)
+            with tracer.span(f"call/{name}"):
+                return getattr(self, name)(*args, **kwargs)
+        # planner feedback: per-(function, size-class) wall-ms
+        # coefficients accumulate from every dispatch, so SQL plans
+        # over these functions estimate from observed cost
+        import time as _time
+        rows = 1
+        for a in args:
+            try:                       # 0-d arrays advertise __len__
+                rows = len(a)          # but raise on it
+                break
+            except TypeError:
+                continue
+        t0 = _time.perf_counter()
         if not tracer.enabled:
-            return getattr(self, name)(*args, **kwargs)
-        with tracer.span(f"call/{name}"):
-            return getattr(self, name)(*args, **kwargs)
+            out = getattr(self, name)(*args, **kwargs)
+        else:
+            with tracer.span(f"call/{name}"):
+                out = getattr(self, name)(*args, **kwargs)
+        planner.observe_op(f"fn/{name}", rows,
+                           _time.perf_counter() - t0)
+        return out
 
     def use_mesh(self, mesh, axis: str = "data") -> "MosaicContext":
         """Bind a ``jax.sharding.Mesh`` so mesh-aware operators (the

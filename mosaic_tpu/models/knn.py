@@ -414,7 +414,46 @@ class SpatialKNN(IterativeTransformer):
                                           self.distance_threshold)
             return self._result(lp, rp, ids, d2, iterations=0,
                                 rechecked=len(lp))
-        return self._transform_points(lp, rp)
+        # timed so the planner's knn/brute vs knn/ring cost
+        # coefficients learn from every run (sql/planner.py)
+        import time as _time
+        t0 = _time.perf_counter()
+        out = self._transform_points(lp, rp)
+        d = getattr(self, "_last_decision", None)
+        if d is not None:
+            from ..sql.planner import planner
+            planner.observe_decision(d, _time.perf_counter() - t0)
+        return out
+
+    def _points_strategy(self, n: int, m: int):
+        """Resolve brute vs. ring for an n-left x m-right point
+        workload.  Both paths are exact (same f64 re-rank, ties by
+        right id) so this is purely a speed choice: the
+        ``mosaic.knn.strategy`` conf pin wins, then the planner's
+        learned cost model, then the built-in right-side threshold
+        (``brute_right_max``, the previous hard-coded dispatch).
+        Mesh-sharded runs keep the ring path — its top-k state and
+        window scans shard; the brute pass is single-device."""
+        from ..config import default_config
+        from ..sql.planner import Decision, planner
+        if self.mesh is not None or m == 0:
+            return "ring", None
+        threshold = self.brute_right_max
+        conf = getattr(default_config(), "knn_strategy", "auto")
+        if conf not in ("auto", "brute", "ring"):
+            threshold = int(conf)       # numeric conf: new threshold
+            conf = "auto"
+        if conf != "auto":
+            d = None
+            if planner.enabled:
+                d = planner.record_decision(Decision(
+                    "knn", conf, "forced by mosaic.knn.strategy", n,
+                    cost_key=f"knn/{conf}", key_n=n, forced=True))
+            return conf, d
+        if planner.enabled:
+            d = planner.decide_knn(n, m, threshold)
+            return d.strategy, d
+        return ("brute" if 0 < m <= threshold else "ring"), None
 
     def _brute_device_topk(self, left_xy: np.ndarray,
                            right_xy: np.ndarray):
@@ -529,10 +568,9 @@ class SpatialKNN(IterativeTransformer):
         right_xy = np.asarray(right_xy, np.float64)
         k = self.k
         n = len(left_xy)
-        # mesh-sharded runs keep the ring path (its top-k state and
-        # window scans shard; the brute pass is single-device)
-        if self.mesh is None and \
-                0 < len(right_xy) <= self.brute_right_max:
+        strategy, self._last_decision = self._points_strategy(
+            n, len(right_xy))
+        if strategy == "brute":
             return self._brute_device_topk(left_xy, right_xy)
         self._idx, self._rowmap, residual = build_knn_indexes(
             right_xy, self.res, self.grid)

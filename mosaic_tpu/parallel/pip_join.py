@@ -303,9 +303,18 @@ def make_pip_join_fn(idx, grid: IndexSystem, eps: Optional[float] = None,
     return fn
 
 
+def _resolve_chunk(chunk: Optional[int]) -> int:
+    """Caller-supplied chunk rows, else ``mosaic.stream.chunk.rows``
+    (the previous hard-coded 262_144 is now that key's default)."""
+    if chunk is not None:
+        return int(chunk)
+    from ..config import default_config
+    return int(default_config().stream_chunk_rows)
+
+
 def make_streamed_pip_join(idx, grid: IndexSystem,
                            polys: Optional[GeometryArray] = None,
-                           chunk: int = 262_144,
+                           chunk: Optional[int] = None,
                            eps: Optional[float] = None,
                            margin_eps: Optional[float] = None,
                            precision: str = "auto"):
@@ -323,6 +332,7 @@ def make_streamed_pip_join(idx, grid: IndexSystem,
 
     Returns ``run(points64_abs) -> (zone [N] int32, rechecked
     count)``."""
+    chunk = _resolve_chunk(chunk)
     fn = jax.jit(make_pip_join_fn(idx, grid, eps, margin_eps, precision))
     recheck = host_recheck_fn(idx, polys)
     origin = np.asarray(idx.origin)
@@ -452,7 +462,7 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
 
 def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
                                    polys: Optional[GeometryArray] = None,
-                                   chunk: int = 262_144,
+                                   chunk: Optional[int] = None,
                                    eps: Optional[float] = None,
                                    margin_eps: Optional[float] = None,
                                    axis: str = "data",
@@ -494,6 +504,7 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
     from ..perf.jit_cache import kernel_cache
     from .placement import SkewRebalancer, placement_slots
 
+    chunk = _resolve_chunk(chunk)
     fn = make_pip_join_fn(idx, grid, eps, margin_eps)
     recheck = host_recheck_fn(idx, polys)
     origin = np.asarray(idx.origin)
@@ -574,6 +585,135 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
         return zone_out, state["rechecked"]
 
     run.rebalancer = rebalancer
+    return run
+
+
+def make_planned_pip_join(idx, grid: IndexSystem,
+                          polys: Optional[GeometryArray] = None,
+                          mesh=None,
+                          eps: Optional[float] = None,
+                          margin_eps: Optional[float] = None,
+                          precision: str = "auto",
+                          axis: str = "data"):
+    """Cost-based adaptive entry point over the whole PIP join family.
+
+    Per call the planner (sql/planner.py) picks monolithic single
+    launch vs. :func:`make_streamed_pip_join` (per chunk class) vs.
+    :func:`make_sharded_streamed_pip_join` from its learned
+    per-(strategy, size-class) cost coefficients — cold it falls back
+    to the batch-vs-chunk threshold.  Every candidate is a pure
+    strategy transform: same localize (f64 origin shift before the f32
+    cast), same jitted kernel, same f64 recheck authority, so the
+    zones are bit-for-bit identical whichever path runs.  The cheap
+    pre-pass feeds the estimate: the fraction of the point batch's
+    bbox overlapping the polygon extent bounds the matched rows.
+
+    After each call the observed wall time and matched-row count flow
+    back into the planner, so a workload's second run is planned from
+    measurement.  ``run.calibrate(points64)`` runs EVERY candidate
+    once (asserting pairwise parity) to seed the coefficients — the
+    bench's A/B sweep uses it so the crossover is learned, not guessed.
+
+    Returns ``run(points64_abs) -> (zone [N] int32, rechecked
+    count)``; ``run.last_decision`` exposes the most recent pick."""
+    import time as _time
+    from ..sql.planner import planner
+
+    variants: dict = {}
+    mesh_devices = int(np.prod(list(mesh.shape.values()))) \
+        if mesh is not None else 1
+    poly_ext = None
+    if polys is not None and len(polys):
+        bb = polys.bboxes()
+        poly_ext = (float(np.nanmin(bb[:, 0])),
+                    float(np.nanmin(bb[:, 1])),
+                    float(np.nanmax(bb[:, 2])),
+                    float(np.nanmax(bb[:, 3])))
+
+    def _variant(strategy: str, chunk: int):
+        key = (strategy, chunk if strategy == "streamed" else 0)
+        if key in variants:
+            return variants[key]
+        if strategy == "monolithic":
+            fn = jax.jit(make_pip_join_fn(idx, grid, eps, margin_eps,
+                                          precision))
+            recheck = host_recheck_fn(idx, polys)
+            origin = np.asarray(idx.origin)
+
+            def mono(points64):
+                points64 = np.asarray(points64, np.float64)[:, :2]
+                z, unc = fn(jnp.asarray(np.asarray(
+                    points64 - origin[None], np.float32)))
+                z = np.asarray(z)
+                unc = np.asarray(unc)
+                return recheck(points64, z, unc), int(unc.sum())
+
+            variants[key] = mono
+        elif strategy == "sharded":
+            variants[key] = make_sharded_streamed_pip_join(
+                idx, grid, mesh, polys=polys, chunk=chunk, eps=eps,
+                margin_eps=margin_eps, axis=axis)
+        else:
+            variants[key] = make_streamed_pip_join(
+                idx, grid, polys=polys, chunk=chunk, eps=eps,
+                margin_eps=margin_eps, precision=precision)
+        return variants[key]
+
+    def _overlap_frac(points64: np.ndarray) -> Optional[float]:
+        # bbox-overlap sketch: what fraction of the point batch's bbox
+        # intersects the polygon extent — an upper bound on match rate
+        if poly_ext is None or not len(points64):
+            return None
+        lo = points64.min(axis=0)
+        hi = points64.max(axis=0)
+        w = max(hi[0] - lo[0], 1e-12) * max(hi[1] - lo[1], 1e-12)
+        iw = max(0.0, min(hi[0], poly_ext[2]) - max(lo[0], poly_ext[0]))
+        ih = max(0.0, min(hi[1], poly_ext[3]) - max(lo[1], poly_ext[1]))
+        return min(1.0, (iw * ih) / w)
+
+    def run(points64: np.ndarray):
+        points64 = np.asarray(points64, np.float64)[:, :2]
+        n = len(points64)
+        d = planner.decide_pip_join(n, mesh_devices,
+                                    in_extent_frac=_overlap_frac(
+                                        points64))
+        strategy, chunk = d.strategy, getattr(
+            d, "chunk", planner.chunk_rows())
+        if strategy == "sharded" and mesh is None:
+            strategy = "streamed"   # forced sharded without a mesh
+        t0 = _time.perf_counter()
+        zone, rechecked = _variant(strategy, chunk)(points64)
+        planner.observe_decision(d, _time.perf_counter() - t0,
+                                 rows_out=int((zone >= 0).sum()))
+        run.last_decision = d
+        return zone, rechecked
+
+    def calibrate(points64: np.ndarray):
+        """Run every candidate once on this batch: seeds the planner's
+        coefficients AND asserts the paths agree bit-for-bit."""
+        points64 = np.asarray(points64, np.float64)[:, :2]
+        n = len(points64)
+        ref = None
+        for strategy, chunk in planner.pip_join_candidates(
+                n, mesh_devices):
+            fn = _variant(strategy, chunk)
+            fn(points64)            # warm: keep compiles out of the
+            t0 = _time.perf_counter()   # learned coefficients
+            zone, _ = fn(points64)
+            wall = _time.perf_counter() - t0
+            planner.observe_op(planner.pip_cost_key(strategy, chunk),
+                               n, wall,
+                               rows_out=int((zone >= 0).sum()))
+            if ref is None:
+                ref = zone
+            elif not np.array_equal(ref, zone):
+                raise AssertionError(
+                    f"pip_join strategy {strategy!r} (chunk {chunk}) "
+                    "diverged from the reference path")
+        return ref
+
+    run.calibrate = calibrate
+    run.last_decision = None
     return run
 
 

@@ -34,6 +34,7 @@ from ..core.geometry.array import GeometryArray
 from ..obs import metrics, new_trace, recorder, tracer
 from .parser import (Binary, Call, Column, Literal, Query, SelectItem,
                      Star, Unary, parse)
+from .planner import planner
 
 GENERATORS = {"grid_tessellateexplode", "mosaic_explode",
               "grid_cellkringexplode", "grid_cellkloopexplode",
@@ -156,6 +157,22 @@ def _numeric(x):
     return x
 
 
+def _vectorized_equi_join(lk: np.ndarray, rk: np.ndarray):
+    """Sort-based single-key equi-join emitting the exact pair order
+    of the dict-loop: left ascending, right index-ascending within
+    each key (stable argsort preserves insertion order of dups)."""
+    order = np.argsort(rk, kind="stable")
+    rs = rk[order]
+    starts = np.searchsorted(rs, lk, "left")
+    counts = np.searchsorted(rs, lk, "right") - starts
+    total = int(counts.sum())
+    li = np.repeat(np.arange(len(lk), dtype=np.int64), counts)
+    offs = np.repeat(np.cumsum(counts) - counts, counts)
+    out = np.arange(total, dtype=np.int64) - offs + \
+        np.repeat(starts, counts)
+    return li, order[out].astype(np.int64)
+
+
 class SQLSession:
     """Named tables + query execution (reference: the SparkSession the
     MosaicSQL extension installs into)."""
@@ -234,19 +251,29 @@ class SQLSession:
         q = parse(query)
         if q.explain == "plan":
             ops = self._plan_ops(q)
+            # strategy column: the planner's chosen path + why per
+            # operator ("-" when the planner is off or has no choice)
+            plan = planner.plan_query(q, self) if planner.enabled \
+                else None
             return Table({"operator": [o for o, _ in ops],
-                          "detail": [d for _, d in ops]})
+                          "detail": [d for _, d in ops],
+                          "strategy": [plan.label(o) if plan is not None
+                                       else "-" for o, _ in ops]})
         if q.explain == "analyze":
             prof: List[tuple] = []
             self._execute(q, prof)
             # all_to_all_bytes / shard_skew attribute the sharded
             # exchange (parallel/overlay collective accounting) to the
             # operator row that moved the bytes — zero rows mean the
-            # operator never left one device
+            # operator never left one device; est_rows is the planner's
+            # pre-pass cardinality estimate (-1 = no estimate), placed
+            # next to actual rows so mispredicts read off per operator
             return Table({"operator": [p[0] for p in prof],
                           "detail": [p[1] for p in prof],
                           "rows": np.asarray([p[2] for p in prof],
                                              np.int64),
+                          "est_rows": np.asarray([p[6] for p in prof],
+                                                 np.int64),
                           "time_ms": np.asarray([p[3] * 1e3
                                                  for p in prof]),
                           "all_to_all_bytes": np.asarray(
@@ -286,6 +313,13 @@ class SQLSession:
     _SKEW_SITES = ("overlay", "overlay_pairs", "pip_join")
 
     def _execute(self, q: Query, prof: Optional[List[tuple]]) -> Table:
+        # cost-based pre-pass: per-operator cardinality estimates +
+        # strategy picks.  _equi_join reads the join decision off the
+        # plan; every stage below closes its estimate so the planner's
+        # coefficient store learns from this run (sql/planner.py)
+        plan = planner.plan_query(q, self) if planner.enabled else None
+        self._active_plan = plan
+
         def stage(op: str, detail: str, fn, rows_of):
             # nested under the sql/query root span -> qualified as
             # sql/query/<op>, a child in the query's trace tree
@@ -294,6 +328,10 @@ class SQLSession:
                 t0 = time.perf_counter()
                 res = fn()
                 dt = time.perf_counter() - t0
+            rows = rows_of(res)
+            step = plan.steps.get(op) if plan is not None else None
+            if step is not None:
+                planner.observe_step(step, rows, dt)
             if prof is not None:
                 # bytes this stage pushed through sharded exchanges;
                 # when nonzero, the current shard/skew/* gauges were
@@ -303,8 +341,9 @@ class SQLSession:
                 skew = max((metrics.gauge_value(f"shard/skew/{s}")
                             or 0.0)
                            for s in self._SKEW_SITES) if a2a else 0.0
-                prof.append((op, detail, rows_of(res), dt,
-                             int(a2a), float(skew)))
+                prof.append((op, detail, rows, dt, int(a2a),
+                             float(skew),
+                             step.est_rows if step is not None else -1))
             if metrics.enabled:
                 metrics.observe(f"sql/{op}_s", dt)
             return res
@@ -426,18 +465,63 @@ class SQLSession:
                                "table per side")
             lkeys.append(np.asarray(_numeric(lv)))
             rkeys.append(np.asarray(_numeric(rv)))
-        # composite key -> dict of right-row lists
-        rmap: Dict[object, List[int]] = {}
-        for j in range(len(right)):
-            k = tuple(rk[j] for rk in rkeys)
-            rmap.setdefault(k, []).append(j)
-        li, ri = [], []
-        for i in range(len(left)):
-            k = tuple(lk[i] for lk in lkeys)
-            for j in rmap.get(k, ()):
-                li.append(i)
-                ri.append(j)
-        return np.asarray(li, np.int64), np.asarray(ri, np.int64)
+        # planner strategy: dict-loop (low fixed cost) vs. vectorized
+        # sort-join (wins past a few thousand rows).  Both emit pairs
+        # left-ascending with right rows index-ascending within each
+        # key, so the choice is invisible in the result.  The decision
+        # usually rides in on the query plan; direct _equi_join calls
+        # decide here.
+        d = None
+        if getattr(self, "_active_plan", None) is not None:
+            js = next((s for s in self._active_plan.steps.values()
+                       if s.op.endswith("_join")), None)
+            d = getattr(js, "decision", None)
+        if d is None and planner.enabled:
+            d = planner.decide_equi_join(len(left), len(right))
+        use_vec = (d is not None and d.strategy == "vectorized" and
+                   self._vector_join_ok(lkeys, rkeys))
+        t0 = time.perf_counter()
+        if use_vec:
+            li, ri = _vectorized_equi_join(lkeys[0], rkeys[0])
+        else:
+            # composite key -> dict of right-row lists
+            rmap: Dict[object, List[int]] = {}
+            for j in range(len(right)):
+                k = tuple(rk[j] for rk in rkeys)
+                rmap.setdefault(k, []).append(j)
+            li, ri = [], []
+            for i in range(len(left)):
+                k = tuple(lk[i] for lk in lkeys)
+                for j in rmap.get(k, ()):
+                    li.append(i)
+                    ri.append(j)
+            li = np.asarray(li, np.int64)
+            ri = np.asarray(ri, np.int64)
+        if d is not None:
+            # feed the coefficient of the path that actually ran (a
+            # vectorized pick can fall back on ineligible keys)
+            key = d.cost_key if use_vec or d.strategy != "vectorized" \
+                else "equi_join/loop"
+            planner.observe_op(key, d.key_n,
+                               time.perf_counter() - t0,
+                               rows_out=int(len(li)))
+        return li, ri
+
+    @staticmethod
+    def _vector_join_ok(lkeys, rkeys) -> bool:
+        """The sort-join handles exactly the cases where its equality
+        semantics match the dict loop: one key pair, same non-object
+        dtype, and no NaN keys (NaN never equals itself in the dict
+        but searchsorted would pair NaNs)."""
+        if len(lkeys) != 1:
+            return False
+        lk, rk = lkeys[0], rkeys[0]
+        if lk.dtype != rk.dtype or lk.dtype.kind not in "iufSU":
+            return False
+        if lk.dtype.kind == "f" and (np.isnan(lk).any() or
+                                     np.isnan(rk).any()):
+            return False
+        return True
 
     @staticmethod
     def _take_env(env: "_Env", idx: np.ndarray) -> "_Env":

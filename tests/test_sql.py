@@ -352,6 +352,10 @@ def test_explain_and_explain_analyze(session):
     ops = list(plan.columns["operator"])
     assert ops == ["scan", "filter", "project"]
     assert "rows" not in plan.columns
+    # the planner annotates each operator with its chosen strategy
+    strategies = list(plan.columns["strategy"])
+    assert len(strategies) == len(ops)
+    assert all(isinstance(s, str) and s for s in strategies)
     # EXPLAIN ANALYZE: executed plan with per-operator rows + wall time
     out = session.sql("EXPLAIN ANALYZE SELECT k, v FROM ea "
                       "WHERE v > 1.5 ORDER BY v DESC LIMIT 2")
@@ -363,6 +367,12 @@ def test_explain_and_explain_analyze(session):
     assert out.columns["rows"].dtype == np.int64
     times = out.columns["time_ms"]
     assert len(times) == 5 and all(t >= 0.0 for t in times.tolist())
+    # est_rows: the planner's pre-pass cardinality estimate next to
+    # the observed rows (-1 when the planner had no estimate)
+    est = out.columns["est_rows"]
+    assert est.dtype == np.int64 and len(est) == 5
+    erows = dict(zip(ops, est.tolist()))
+    assert erows["scan"] == 4       # scan cardinality is exact
     # aggregates show as an aggregate operator with group-key detail
     agg = session.sql("EXPLAIN ANALYZE SELECT k, count(*) AS n "
                       "FROM ea GROUP BY k")
