@@ -92,7 +92,6 @@ import dataclasses
 import glob
 import json
 import os
-import re
 import subprocess
 import sys
 import time
@@ -183,16 +182,14 @@ def same_platform_benches(platform: str):
     """All ``(round_tag, record)`` BENCH_r*.json entries on
     ``platform``, oldest first — the trajectory the perf guard
     compares against."""
-    out = []
-    for path in sorted(glob.glob(os.path.join(HERE, "BENCH_r*.json"))):
-        try:
-            rec = json.loads(open(path).read().strip().splitlines()[-1])
-        except (OSError, ValueError, IndexError):
-            continue
-        if rec.get("platform") == platform:
-            m = re.search(r"BENCH_r(\d+)", path)
-            out.append((m.group(1) if m else path, rec))
-    return out
+    # tools.bench_watchdog owns the parsing: BENCH files come in three
+    # shapes (bare JSONL record, pretty-printed record, runner wrapper
+    # with the record embedded in its stdout "tail") and the guard was
+    # silently blind to the wrapper shape before the watchdog landed.
+    if HERE not in sys.path:
+        sys.path.insert(0, HERE)
+    from tools.bench_watchdog import load_history
+    return load_history(HERE, platform)
 
 
 def perf_guard(current: dict, platform: str, slip: float = 0.20,
@@ -307,6 +304,31 @@ def main():
                                 sample_memory, to_openmetrics, tracer)
     tracer.enable()                 # also enables the metrics registry
     install_jax_listeners()
+    # telemetry plane: background sampler at the DEFAULT cadence folds
+    # registry counters/gauges into the in-memory time-series store and
+    # evaluates the default SLOs while the bench runs.  Deliberately on
+    # for every bench run — the perf guard then doubles as the sampler
+    # overhead check (a sampler that costs real time trips the guard).
+    from mosaic_tpu.obs import monitor as _slo_monitor
+    from mosaic_tpu.obs import start_sampler, timeseries
+    # MOSAIC_TPU_OBS_SAMPLE_MS pins the cadence; an explicit 0 opts
+    # the bench out entirely (the slo-smoke lane's overhead A/B)
+    _env_ms = os.environ.get("MOSAIC_TPU_OBS_SAMPLE_MS")
+    if _env_ms is not None and float(_env_ms) <= 0:
+        _sampler = None
+    else:
+        _sampler = start_sampler(float(_env_ms) if _env_ms else None)
+
+    def telemetry_report():
+        """sampler + SLO blocks for the BENCH record."""
+        return ({"interval_ms":
+                 _sampler.interval_ms if _sampler else 0.0,
+                 "ticks": _sampler.ticks if _sampler else 0,
+                 "series": len(timeseries.names())},
+                {"alerts_active": _slo_monitor.alerts_active(),
+                 "breaches": _slo_monitor.breach_count(),
+                 "active": sorted(a["name"] for a in
+                                  _slo_monitor.active_alerts())})
     # one trace context for the whole run: every bench stage span (and
     # the spans inside the ops they drive) groups into a single "bench"
     # lane in the Chrome-trace export / report()["traces"].  Entered
@@ -635,6 +657,7 @@ def main():
         record["probes"] = PROBE_EVENTS
         record["openmetrics_path"] = write_openmetrics()
         record["jit_cache"] = jit_cache_report()
+        record["sampler"], record["slo"] = telemetry_report()
         print(json.dumps(record))
         return
 
@@ -840,10 +863,29 @@ def main():
         "openmetrics_path": write_openmetrics(),
         "jit_cache": jit_cache_report(),
     })
+    record["sampler"], record["slo"] = telemetry_report()
     regressions = perf_guard(record, platform)
     for msg in regressions:
         log(f"PERF REGRESSION: {msg}")
     record["perf_regressions"] = regressions
+    # trajectory watchdog (tools/bench_watchdog): variance spikes and
+    # drifts the binary guard misses; markdown report lands next to
+    # the openmetrics snapshot.  Advisory — never fails the run.
+    try:
+        from tools.bench_watchdog import analyze, to_markdown
+        wd = analyze(same_platform_benches(platform), record)
+        for line in wd["flags"]:
+            log(f"WATCHDOG: {line}")
+        record["watchdog"] = {"status": wd["status"],
+                              "flags": wd["flags"]}
+        import tempfile
+        wd_path = os.path.join(tempfile.gettempdir(),
+                               f"mosaic_bench_{os.getpid()}_watchdog.md")
+        with open(wd_path, "w") as f:
+            f.write(to_markdown(wd, platform=platform))
+        record["watchdog"]["report_path"] = wd_path
+    except Exception as e:
+        log(f"bench watchdog failed: {e}")
     print(json.dumps(record))
 
 

@@ -29,6 +29,16 @@ MOSAIC_METRICS_ENABLED = "mosaic.metrics.enabled"
 # Slow-query flight-recorder dump threshold in milliseconds; 0 (the
 # default) disables the automatic dump (see mosaic_tpu/obs/recorder.py).
 MOSAIC_OBS_SLOW_QUERY_MS = "mosaic.obs.slow.query.ms"
+# Telemetry-sampler cadence in milliseconds (obs/timeseries.py): > 0
+# starts a background thread snapshotting every registry metric into
+# the bounded time-series store (and driving SLO evaluation + the
+# per-device fold) on that cadence; 0 (the default) keeps it off.
+# Env var MOSAIC_TPU_OBS_SAMPLE_MS pins the cadence over this key.
+MOSAIC_OBS_SAMPLE_MS = "mosaic.obs.sample.ms"
+# Write a flight-recorder dump bundle on every SLO breach transition
+# (obs/slo.py); off by default — breaches always raise the recorder
+# event + gauges regardless.
+MOSAIC_OBS_SLO_DUMP = "mosaic.obs.slo.dump"
 MOSAIC_CRS_STRICT_DATUM = "mosaic.crs.strict.datum"
 # Precision-policy keys (fields existed since round 1; the conf spelling
 # maps onto them so conf-driven deployments can set the policy too).
@@ -102,6 +112,12 @@ class MosaicConfig:
     # SQLSession.sql() calls slower than this many milliseconds trigger
     # an automatic flight-recorder dump; 0 disables the trigger.
     obs_slow_query_ms: float = 0.0
+    # Telemetry-sampler cadence (ms): registry -> time-series store
+    # snapshots + SLO evaluation + per-device fold run on a background
+    # thread at this interval.  0 (default) = no sampler thread.
+    obs_sample_ms: float = 0.0
+    # Dump a flight bundle whenever an SLO objective newly breaches.
+    obs_slo_dump: bool = False
     # Raise (instead of warn) when a CRS transform would silently apply
     # an identity datum shift because the EPSG registry carries no
     # Helmert parameters for the code (helmert_acc is NaN).
@@ -230,6 +246,8 @@ _CONF_FIELDS = {
     MOSAIC_TRACE_ENABLED: ("trace_enabled", _as_flag),
     MOSAIC_METRICS_ENABLED: ("metrics_enabled", _as_flag),
     MOSAIC_OBS_SLOW_QUERY_MS: ("obs_slow_query_ms", _as_millis),
+    MOSAIC_OBS_SAMPLE_MS: ("obs_sample_ms", _as_millis),
+    MOSAIC_OBS_SLO_DUMP: ("obs_slo_dump", _as_flag),
     MOSAIC_CRS_STRICT_DATUM: ("crs_strict_datum", _as_flag),
     MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
     MOSAIC_JIT_CACHE_DIR: ("jit_cache_dir", _as_str),
@@ -304,9 +322,14 @@ def set_default_config(cfg: MosaicConfig) -> None:
     _default_config = cfg
     # Conf-driven observability enablement (one-way: never disables an
     # instrument the env or an explicit enable() already turned on).
-    if cfg.trace_enabled or cfg.metrics_enabled:
+    # The sampler cadence routes through here too (change-detecting,
+    # env-pinned-safe — see obs.timeseries.configure_sampler).
+    if cfg.trace_enabled or cfg.metrics_enabled or cfg.obs_sample_ms:
         from .obs import configure
         configure(cfg)
+    else:
+        from .obs.timeseries import configure_sampler
+        configure_sampler(0.0)
     if cfg.jit_cache_dir:
         from .perf.jit_cache import configure_persistent_cache
         configure_persistent_cache(cfg.jit_cache_dir)

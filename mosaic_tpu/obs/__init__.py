@@ -1,7 +1,7 @@
 """Observability subsystem: metrics, tracing, flight recorder, export.
 
 Grown out of ``mosaic_tpu.utils.trace`` (which remains as a compat
-shim).  Seven parts:
+shim).  Eleven parts:
 
 * ``obs.metrics`` — process-global registry of counters, gauges, and
   exponential-bucket histograms (p50/p95/p99 derivable).
@@ -21,7 +21,19 @@ shim).  Seven parts:
   one lane per trace.
 * ``obs.openmetrics`` — Prometheus text exposition
   (``metrics.to_openmetrics()``) and the stdlib ``serve_metrics(port)``
-  scrape endpoint.
+  scrape endpoint (stoppable ``ServerHandle``).
+* ``obs.timeseries`` — bounded metric time-series store with
+  multi-resolution rollups, windowed queries (rate / max / quantile),
+  and the background :class:`Sampler` (``mosaic.obs.sample.ms`` /
+  ``MOSAIC_TPU_OBS_SAMPLE_MS``).
+* ``obs.slo`` — declarative SLO objectives with multi-window
+  burn-rate alerting (``slo_breach`` recorder events, the
+  ``obs/alerts_active`` gauge, ``mosaic_slo_*`` OpenMetrics series).
+* ``obs.devicemon`` — continuous per-device attribution: memory
+  watermarks, routed rows, and wall time charged to devices by load
+  share (feeds the EXPLAIN ANALYZE ``device_ms`` column).
+* ``obs.dashboard`` — the live ops dashboard: JSON endpoints +
+  a self-contained polling HTML page (``serve_dashboard(port)``).
 
 The tracer and registry are disabled by default and cost one attribute
 check per instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
@@ -34,15 +46,24 @@ one-attribute-check quiescent cost.
 
 from __future__ import annotations
 
+import os as _os
+
 from .chrometrace import chrome_trace_events, export_chrome_trace
 from .context import (TraceContext, current_trace, current_trace_id,
                       install_thread_propagation, new_trace, root_trace,
                       traced)
+from .dashboard import serve_dashboard
+from .devicemon import DeviceMonitor, devicemon, mesh_device_keys
 from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
-                     record_cost_analysis, sample_memory)
+                     last_watermarks, record_cost_analysis,
+                     sample_memory)
 from .metrics import Histogram, MetricsRegistry, metrics
-from .openmetrics import serve_metrics, to_openmetrics
+from .openmetrics import ServerHandle, serve_metrics, to_openmetrics
 from .recorder import FlightRecorder, install_excepthook, recorder
+from .slo import SLObjective, SLOMonitor, default_objectives, monitor
+from .timeseries import (Sampler, TimeSeriesStore, configure_sampler,
+                         sampler, start_sampler, stop_sampler,
+                         timeseries)
 from .tracer import (SpanEvent, Tracer, device_trace, record_command,
                      record_error, tracer)
 
@@ -54,9 +75,14 @@ __all__ = [
     "current_trace_id", "traced", "install_thread_propagation",
     "FlightRecorder", "recorder", "install_excepthook",
     "install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
-    "record_cost_analysis",
+    "record_cost_analysis", "last_watermarks",
     "chrome_trace_events", "export_chrome_trace",
-    "to_openmetrics", "serve_metrics",
+    "to_openmetrics", "serve_metrics", "ServerHandle",
+    "TimeSeriesStore", "timeseries", "Sampler", "start_sampler",
+    "stop_sampler", "sampler", "configure_sampler",
+    "SLObjective", "SLOMonitor", "monitor", "default_objectives",
+    "DeviceMonitor", "devicemon", "mesh_device_keys",
+    "serve_dashboard",
     "configure",
 ]
 
@@ -65,6 +91,20 @@ __all__ = [
 install_thread_propagation()
 install_excepthook()
 
+# Env-pinned telemetry sampler: MOSAIC_TPU_OBS_SAMPLE_MS=<ms> starts
+# the background sampler at import (and pins the cadence against conf
+# changes — see timeseries.configure_sampler).  Implies the registry:
+# a sampler over a disabled registry would record nothing.
+_env_ms = _os.environ.get("MOSAIC_TPU_OBS_SAMPLE_MS", "").strip()
+if _env_ms:
+    try:
+        _ms = float(_env_ms)
+    except ValueError:
+        _ms = 0.0
+    if _ms > 0:
+        metrics.enable()
+        start_sampler(_ms)
+
 
 def configure(config) -> None:
     """Apply a ``MosaicConfig``'s observability switches (idempotent).
@@ -72,8 +112,15 @@ def configure(config) -> None:
     ``trace_enabled`` turns the tracer (and with it the registry) on;
     ``metrics_enabled`` turns just the registry on.  Neither flag ever
     turns an already-enabled instrument off — env vars and explicit
-    ``enable()`` calls win."""
+    ``enable()`` calls win.  ``obs_sample_ms`` drives the telemetry
+    sampler lifecycle (change-detecting; the env var pins it — see
+    ``timeseries.configure_sampler``)."""
     if getattr(config, "trace_enabled", False):
         tracer.enable()
     if getattr(config, "metrics_enabled", False):
         metrics.enable()
+    ms = getattr(config, "obs_sample_ms", None)
+    if ms is not None:
+        if ms > 0:        # a sampler over a disabled registry records
+            metrics.enable()   # nothing — the cadence implies metrics
+        configure_sampler(ms)

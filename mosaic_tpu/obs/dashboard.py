@@ -1,0 +1,241 @@
+"""Live ops dashboard: JSON endpoints + one self-contained HTML page.
+
+Reference counterpart: the Spark UI.  Standalone we extend the stdlib
+``serve_metrics`` scrape server into a small operator console — no
+templates, no JS bundles, no new dependencies; the page is one inline
+HTML string that polls the JSON endpoints below with ``fetch()``.
+
+Routes:
+
+* ``/``                 — the polling HTML page
+* ``/metrics``          — the OpenMetrics exposition (scraper compat)
+* ``/api/summary``      — alerts_active, series/metric counts, uptime
+* ``/api/series``       — known time-series names (``?prefix=``)
+* ``/api/timeseries``   — windowed stats + raw points for one series
+  (``?name=...&window=300``)
+* ``/api/alerts``       — active SLO breaches + recent breach events
+* ``/api/traces``       — recent completed trace trees (tracer on)
+* ``/api/planner``      — planner decisions/coefficients report
+* ``/api/devices``      — per-device attribution (``obs.devicemon``)
+
+``serve_dashboard(port=0)`` returns the same stoppable
+:class:`~.openmetrics.ServerHandle` as ``serve_metrics`` — close it
+with ``handle.close()``.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import time
+import urllib.parse
+from typing import Dict, Optional
+
+from .metrics import metrics
+from .openmetrics import CONTENT_TYPE, ServerHandle, start_server, \
+    to_openmetrics
+from .recorder import recorder
+from .timeseries import timeseries
+from .tracer import tracer
+
+__all__ = ["serve_dashboard"]
+
+_MAX_POINTS = 500          # raw points per /api/timeseries response
+_MAX_TRACES = 20
+_MAX_EVENTS = 50
+
+
+def _summary(t0: float) -> Dict[str, object]:
+    from .slo import monitor
+    from .timeseries import sampler
+    rep = metrics.report()
+    smp = sampler()
+    return {
+        "ts": time.time(),
+        "uptime_s": round(time.time() - t0, 1),
+        "alerts_active": monitor.alerts_active(),
+        "breaches": monitor.breach_count(),
+        "series": len(timeseries),
+        "counters": len(rep["counters"]),
+        "gauges": len(rep["gauges"]),
+        "histograms": len(rep["histograms"]),
+        "metrics_enabled": metrics.enabled,
+        "sampler": {"running": smp is not None and smp.alive,
+                    "interval_ms": smp.interval_ms if smp else 0,
+                    "ticks": smp.ticks if smp else 0},
+    }
+
+
+def _timeseries_payload(qs: Dict[str, list]) -> Dict[str, object]:
+    name = (qs.get("name") or [""])[0]
+    try:
+        window = float((qs.get("window") or ["300"])[0])
+    except ValueError:
+        window = 300.0
+    s = timeseries.series(name)
+    if s is None:
+        return {"name": name, "window_s": window, "found": False,
+                "stats": {}, "points": []}
+    now = time.time()
+    pts = [(t, v) for t, v in s.raw if t >= now - window]
+    if len(pts) > _MAX_POINTS:
+        step = len(pts) / _MAX_POINTS
+        pts = [pts[int(i * step)] for i in range(_MAX_POINTS)]
+    return {
+        "name": name, "window_s": window, "found": True,
+        "stats": s.window_stats(window, now),
+        "rate": s.rate(window, now),
+        "p99": s.quantile_over_window(99, window, now),
+        "points": [[round(t, 3), v] for t, v in pts],
+    }
+
+
+def _alerts_payload() -> Dict[str, object]:
+    from .slo import monitor
+    return {
+        "active": monitor.active_alerts(),
+        "objectives": [o["name"] for o in
+                       monitor.report()["objectives"]],
+        "recent_breaches": recorder.events("slo_breach")[-_MAX_EVENTS:],
+        "recent_recoveries":
+            recorder.events("slo_recovered")[-_MAX_EVENTS:],
+    }
+
+
+def _traces_payload() -> Dict[str, object]:
+    traces = tracer.report().get("traces", {})
+    items = list(traces.items())[-_MAX_TRACES:]
+    return {"traces": {tid: {"name": t.get("name"),
+                             "spans": t.get("spans", [])[:200]}
+                       for tid, t in items}}
+
+
+def _planner_payload() -> Dict[str, object]:
+    try:
+        from ..sql.planner import planner
+        return planner.report()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _devices_payload() -> Dict[str, object]:
+    from .devicemon import devicemon
+    return devicemon.report()
+
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>mosaic_tpu ops</title>
+<style>
+ body{font:13px/1.5 system-ui,sans-serif;margin:1.5em;max-width:70em}
+ h1{font-size:1.2em} h2{font-size:1em;margin:1.2em 0 .3em}
+ table{border-collapse:collapse} td,th{padding:.15em .7em;
+  border-bottom:1px solid #ddd;text-align:left;font-variant-numeric:
+  tabular-nums}
+ .ok{color:#2a7} .bad{color:#c33;font-weight:600}
+ #alerts li{color:#c33} code{background:#f4f4f4;padding:0 .3em}
+ svg{border:1px solid #ddd;background:#fafafa}
+</style></head><body>
+<h1>mosaic_tpu ops dashboard</h1>
+<div id="summary">loading…</div>
+<h2>Active alerts</h2><ul id="alerts"><li class="ok">none</li></ul>
+<h2>Series <select id="pick"></select>
+ <span id="stats"></span></h2>
+<svg id="chart" width="640" height="120"></svg>
+<h2>Devices</h2><table id="devices"></table>
+<script>
+const $=id=>document.getElementById(id);
+async function j(u){const r=await fetch(u);return r.json()}
+function draw(pts){const s=$("chart");if(!pts.length){s.innerHTML="";
+ return}const xs=pts.map(p=>p[0]),ys=pts.map(p=>p[1]);
+ const x0=Math.min(...xs),x1=Math.max(...xs)||x0+1,
+ y0=Math.min(...ys),y1=Math.max(...ys);const yr=(y1-y0)||1;
+ const d=pts.map((p,i)=>(i?"L":"M")+(620*(p[0]-x0)/(x1-x0||1)+10)+
+ ","+(110-100*(p[1]-y0)/yr)).join(" ");
+ s.innerHTML='<path d="'+d+'" fill="none" stroke="#27c"/>'}
+async function tick(){
+ const s=await j("/api/summary");
+ $("summary").innerHTML=
+  (s.alerts_active?'<span class="bad">'+s.alerts_active+
+   ' alert(s) active</span>':'<span class="ok">healthy</span>')+
+  " — "+s.series+" series, "+s.counters+" counters, sampler "+
+  (s.sampler.running?s.sampler.interval_ms+"ms ("+s.sampler.ticks+
+   " ticks)":"off")+", up "+s.uptime_s+"s";
+ const a=await j("/api/alerts");
+ $("alerts").innerHTML=a.active.length?a.active.map(x=>"<li>"+x.name+
+  " ("+x.kind+") short="+x.short.toFixed(4)+" long="+
+  x.long.toFixed(4)+" budget="+x.budget.toFixed(4)+"</li>").join("")
+  :'<li class="ok">none</li>';
+ const names=(await j("/api/series")).names;
+ const pick=$("pick");const cur=pick.value;
+ pick.innerHTML=names.map(n=>"<option"+(n===cur?" selected":"")+">"+
+  n+"</option>").join("");
+ if(pick.value){const ts=await j("/api/timeseries?name="+
+  encodeURIComponent(pick.value)+"&window=300");
+  $("stats").textContent=" n="+ts.stats.count+" mean="+
+   (+ts.stats.mean).toPrecision(4)+" max="+
+   (+ts.stats.max).toPrecision(4)+" p99="+(+ts.p99).toPrecision(4);
+  draw(ts.points)}
+ const d=await j("/api/devices");
+ $("devices").innerHTML="<tr><th>device</th><th>busy_s</th>"+
+  "<th>util</th><th>rows</th><th>peak_bytes</th></tr>"+
+  Object.entries(d.devices).map(([k,v])=>"<tr><td>"+k+"</td><td>"+
+   v.busy_s.toFixed(3)+"</td><td>"+(v.util||0).toFixed(2)+
+   "</td><td>"+v.rows+"</td><td>"+(v.peak_bytes||"-")+
+   "</td></tr>").join("");
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""
+
+
+def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
+                    ) -> ServerHandle:
+    """Start the ops dashboard; returns a stoppable
+    :class:`~.openmetrics.ServerHandle` (ephemeral port by default —
+    read it off ``handle.port``)."""
+    t0 = time.time()
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def _send(self, body: bytes, ctype: str) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, payload) -> None:
+            self._send(json.dumps(payload, default=str).encode(),
+                       "application/json")
+
+        def do_GET(self):
+            path, _, query = self.path.partition("?")
+            qs = urllib.parse.parse_qs(query)
+            try:
+                if path == "/":
+                    self._send(_PAGE.encode(), "text/html; charset=utf-8")
+                elif path == "/metrics":
+                    self._send(to_openmetrics().encode(), CONTENT_TYPE)
+                elif path == "/api/summary":
+                    self._json(_summary(t0))
+                elif path == "/api/series":
+                    prefix = (qs.get("prefix") or [""])[0]
+                    self._json({"names": timeseries.names(prefix)})
+                elif path == "/api/timeseries":
+                    self._json(_timeseries_payload(qs))
+                elif path == "/api/alerts":
+                    self._json(_alerts_payload())
+                elif path == "/api/traces":
+                    self._json(_traces_payload())
+                elif path == "/api/planner":
+                    self._json(_planner_payload())
+                elif path == "/api/devices":
+                    self._json(_devices_payload())
+                else:
+                    self.send_error(404)
+            except BrokenPipeError:
+                pass              # poller navigated away mid-response
+
+        def log_message(self, *args):   # polls must not spam stderr
+            pass
+
+    return start_server(_Handler, port, addr, "mosaic-ops-dashboard")

@@ -32,7 +32,7 @@ from .recorder import recorder
 from .tracer import tracer
 
 __all__ = ["install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
-           "record_cost_analysis"]
+           "record_cost_analysis", "last_watermarks"]
 
 # a label re-compiling this many times is a storm (ragged batches)
 STORM_THRESHOLD = 8
@@ -96,13 +96,29 @@ def install_jax_listeners() -> bool:
         return True
 
 
+#: most recent sample_memory() result — the flight recorder embeds it
+#: in dump bundles so a post-mortem shows the last known watermarks
+#: even when the registry was disabled
+_last_watermarks: Dict[str, Dict[str, Optional[float]]] = {}
+
+
+def last_watermarks() -> Dict[str, Dict[str, Optional[float]]]:
+    """The most recent :func:`sample_memory` result (``{}`` before the
+    first sample)."""
+    return dict(_last_watermarks)
+
+
 def sample_memory(devices=None) -> Dict[str, Dict[str, Optional[float]]]:
     """Sample per-device memory watermarks into gauges.
 
     For each device, records ``mem/peak_bytes/<platform>:<id>`` (max-
-    tracked, so repeated samples keep the high-water mark) and returns
-    the raw stats.  Devices without allocator stats (CPU) fall back to
-    the process peak RSS; the ``source`` field says which one you got.
+    tracked, so repeated samples keep the high-water mark) and
+    ``mem/source/<platform>:<id>`` (1 = allocator stats, 0 = host-RSS
+    fallback), and returns the raw stats.  Devices without allocator
+    stats (CPU) fall back to the process peak RSS; the ``source``
+    field says which one you got.  The ``obs.timeseries`` sampler
+    calls this on its cadence, so the gauges populate continuously on
+    bench and SQL paths instead of only when called by hand.
     """
     import jax
     out: Dict[str, Dict[str, Optional[float]]] = {}
@@ -124,8 +140,12 @@ def sample_memory(devices=None) -> Dict[str, Dict[str, Optional[float]]]:
             out[key] = {"peak_bytes": peak, "bytes_in_use": None,
                         "source": "host_rss"}
         metrics.gauge_max(f"mem/peak_bytes/{key}", peak)
+        metrics.gauge(f"mem/source/{key}",
+                      1.0 if out[key]["source"] == "allocator" else 0.0)
     if host_peak:
         metrics.gauge_max("mem/host_peak_rss_bytes", float(host_peak))
+    _last_watermarks.clear()
+    _last_watermarks.update(out)
     return out
 
 

@@ -29,7 +29,7 @@ from typing import List, Optional
 
 from .metrics import MetricsRegistry, _bucket_upper, metrics
 
-__all__ = ["to_openmetrics", "serve_metrics"]
+__all__ = ["to_openmetrics", "serve_metrics", "ServerHandle"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -82,13 +82,74 @@ def to_openmetrics(registry: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+class ServerHandle:
+    """A started HTTP endpoint you can actually stop.
+
+    Wraps the ``ThreadingHTTPServer`` + its serve thread; ``close()``
+    shuts the server down, closes the listening socket and joins the
+    thread.  ``shutdown()`` / ``server_close()`` / ``server_address``
+    are kept as aliases so existing callers of the raw server keep
+    working; the handle is also a context manager."""
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+        self._closed = False
+
+    @property
+    def server_address(self):
+        return self._server.server_address
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # raw-server compat: callers used server.shutdown();
+    # server.server_close() as the teardown pair
+    def shutdown(self) -> None:
+        if not self._closed:
+            self._server.shutdown()
+
+    def server_close(self) -> None:
+        self.close()
+
+    def __enter__(self) -> "ServerHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_server(handler_cls, port: int, addr: str,
+                 name: str) -> ServerHandle:
+    """Spin a ``ThreadingHTTPServer`` on a named daemon thread and
+    return the stoppable handle (shared by the metrics endpoint and
+    the ops dashboard)."""
+    server = http.server.ThreadingHTTPServer((addr, port), handler_cls)
+    thread = threading.Thread(target=server.serve_forever,
+                              name=name, daemon=True)
+    thread.start()
+    return ServerHandle(server, thread)
+
+
 def serve_metrics(port: int = 9464, addr: str = "127.0.0.1",
-                  registry: Optional[MetricsRegistry] = None):
-    """Start a scrape endpoint on a daemon thread; returns the server.
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> ServerHandle:
+    """Start a scrape endpoint on a daemon thread; returns a
+    :class:`ServerHandle`.
 
     ``GET /metrics`` (or ``/``) answers with :func:`to_openmetrics` at
     scrape time.  Pass ``port=0`` for an ephemeral port — the bound one
-    is ``server.server_address[1]``.  Stop with ``server.shutdown()``.
+    is ``handle.port``.  Stop with ``handle.close()``.
     """
 
     class _Handler(http.server.BaseHTTPRequestHandler):
@@ -106,8 +167,4 @@ def serve_metrics(port: int = 9464, addr: str = "127.0.0.1",
         def log_message(self, *args):  # scrapes must not spam stderr
             pass
 
-    server = http.server.ThreadingHTTPServer((addr, port), _Handler)
-    thread = threading.Thread(target=server.serve_forever,
-                              name="mosaic-metrics-http", daemon=True)
-    thread.start()
-    return server
+    return start_server(_Handler, port, addr, "mosaic-metrics-http")

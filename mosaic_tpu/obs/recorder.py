@@ -137,7 +137,8 @@ class FlightRecorder:
     def bundle(self, reason: str = "manual",
                error: Optional[str] = None) -> Dict[str, Any]:
         """Self-contained post-mortem: events + metrics snapshot +
-        resolved config + jax platform info."""
+        metric time-series history + last memory watermarks + resolved
+        config + jax platform info."""
         import dataclasses
 
         from .metrics import metrics
@@ -146,12 +147,24 @@ class FlightRecorder:
             cfg = dataclasses.asdict(_config.default_config())
         except Exception:
             cfg = {}
+        try:                    # history survives into the bundle: the
+            from .timeseries import timeseries   # post-mortem shows the
+            ts_snap = timeseries.snapshot()      # minutes before, not
+        except Exception:                        # just the final values
+            ts_snap = {}
+        try:
+            from .jaxmon import last_watermarks
+            mem = last_watermarks()
+        except Exception:
+            mem = {}
         b: Dict[str, Any] = {
             "reason": reason,
             "ts": time.time(),
             "pid": os.getpid(),
             "events": self.events(),
             "metrics": metrics.report(),
+            "timeseries": ts_snap,
+            "memory": mem,
             "config": cfg,
             "jax": _jax_info(),
         }

@@ -158,6 +158,10 @@ def _account_exchange(site: str, D: int, bucket_cap: int, cap_e: int,
         # series (p50/p95/p99), not just a last-value gauge
         metrics.observe(f"shard/skew_series/{site}", skew)
         metrics.gauge(f"shard/rows_max/{site}", float(counts.max()))
+        # per-device fold: the exchange's routed-row counts land in
+        # the device monitor (device/rows/* counters + dashboard)
+        from ..obs.devicemon import devicemon
+        devicemon.observe_rows(site, counts)
 
 
 def _exact_dup_cap(cells_a: np.ndarray, valid_a: np.ndarray,
@@ -699,6 +703,8 @@ def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
     # retry loops: bucket/dup capacities are static shapes, so a skewed
     # hash or a crowded cell grows them and re-runs instead of failing
     # (overflow is always detected, never silent)
+    import time as _time
+    t0 = _time.perf_counter()
     while True:
         if mesh is None:
             fn = make_overlay_fn(ga, gb, ea.shape[1], eb.shape[1],
@@ -721,6 +727,19 @@ def overlay_intersects(polys_a: GeometryArray, polys_b: GeometryArray,
             dup_cap = int(2 ** np.ceil(np.log2(max(diag[2], 2))))
             continue
         break
+    from ..obs import metrics
+    if mesh is not None and metrics.enabled:
+        # charge the sharded run's wall time to devices by routed-row
+        # share (both sides' hash-destination counts) — feeds the
+        # EXPLAIN ANALYZE device_ms column via obs.devicemon
+        from ..obs.devicemon import devicemon
+        w = np.zeros(D, np.int64)
+        for cc, vv in ((ca, va), (cb, vb)):
+            vv = np.asarray(vv, bool)
+            if vv.any():
+                w += np.bincount(
+                    _hash_dest_np(np.asarray(cc)[vv], D), minlength=D)
+        devicemon.attribute("overlay", _time.perf_counter() - t0, w)
 
     hits = np.asarray(h) > 0
     hz = np.asarray(z) > 0

@@ -434,13 +434,17 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
     D = mesh.shape[axis]
     idx_bytes = sum(int(np.asarray(leaf).nbytes)
                     for leaf in jax.tree_util.tree_leaves(idx))
-    state = {"calls": 0}
+    state = {"calls": 0, "weights": None}
 
     def wrapped(points):
+        import time as _time
         from ..obs import tracer
         from ..obs.context import root_trace
+        from ..obs.devicemon import devicemon, mesh_device_keys
         with root_trace("pip_join"), tracer.span("pip_join/sharded"):
+            t0 = _time.perf_counter()
             out = jfn(points)
+            dt = _time.perf_counter() - t0
         if metrics.enabled:
             metrics.gauge("collective/replicated_index_bytes",
                           float(idx_bytes) * D)
@@ -453,7 +457,12 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
                 if state["calls"] == 0:
                     metrics.count("collective/broadcast_bytes",
                                   float(idx_bytes) * max(D - 1, 1))
-                _shard_skew_readback(np.asarray(out[0]), D)
+                state["weights"] = \
+                    _shard_skew_readback(np.asarray(out[0]), D)
+            # charge dispatch wall time to devices by the last
+            # observed per-shard load (uniform until first readback)
+            devicemon.attribute("pip_join", dt, state["weights"],
+                                mesh_device_keys(mesh))
             state["calls"] += 1
         return out
 
@@ -566,14 +575,26 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
             # host, unlike the monolithic path's cadenced device sync
             rebalancer.observe(points64[sl], z >= 0)
             if metrics.enabled:
-                _shard_skew_readback(zp, D)
+                c = _shard_skew_readback(zp, D)
+                w = state.get("weights")
+                state["weights"] = c if w is None else w + c
                 metrics.gauge("shard/skew_planned/pip_join",
                               rebalancer.planned_skew())
 
+        import time as _time
+        t0 = _time.perf_counter()
         with root_trace("pip_join"), \
                 tracer.span("pip_join/sharded_streamed"):
             stream(chunk_rows(n, chunk), compute=compute, put=put,
                    consume=consume)
+        if metrics.enabled:
+            # per-device wall-time attribution: the run's matched-row
+            # counts per shard (summed over chunks) are the load share
+            from ..obs.devicemon import devicemon, mesh_device_keys
+            devicemon.attribute("pip_join",
+                                _time.perf_counter() - t0,
+                                state.get("weights"),
+                                mesh_device_keys(mesh))
         if metrics.enabled:
             metrics.gauge("collective/replicated_index_bytes",
                           float(idx_bytes) * D)

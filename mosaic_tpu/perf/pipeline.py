@@ -33,9 +33,18 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics
 from ..resilience import faults
 
 __all__ = ["stream", "chunk_rows", "donate_jit"]
+
+
+def _tree_bytes(x) -> int:
+    """Total buffer bytes across a pytree's array leaves (0 for
+    leaves with no nbytes — slices, scalars, handles)."""
+    import jax
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree_util.tree_leaves(x))
 
 
 def chunk_rows(n: int, chunk: int) -> List[slice]:
@@ -85,17 +94,25 @@ def stream(chunks: Sequence, compute: Callable,
     def fetch(i, payload, out):
         faults.maybe_fail("pipeline.fetch")
         host = _to_host(out)        # blocks the WORKER until ready
+        if metrics.enabled:         # device->host drain, per chunk
+            metrics.count("pipeline/d2h_bytes", _tree_bytes(host))
         return consume(i, payload, host) if consume is not None \
             else host
+
+    def staged(payload):
+        dev = put(payload)
+        if metrics.enabled:         # host->device staging, per chunk
+            metrics.count("pipeline/h2d_bytes", _tree_bytes(dev))
+        return dev
 
     results: list = [None] * len(chunks)
     with ThreadPoolExecutor(max_workers=1) as pool:
         futs = []
-        dev = put(chunks[0])
+        dev = staged(chunks[0])
         for i, payload in enumerate(chunks):
             out = compute(dev)
             if i + 1 < len(chunks):
-                dev = put(chunks[i + 1])   # overlap H2D with compute
+                dev = staged(chunks[i + 1])  # overlap H2D with compute
             futs.append(pool.submit(fetch, i, payload, out))
         for i, f in enumerate(futs):
             results[i] = f.result()

@@ -8,7 +8,7 @@ a :class:`FaultPlan` is armed process-wide (programmatically or via
 ``MOSAIC_TPU_FAULT_PLAN``) and cheap probes placed at named sites in
 the io / raster / native / parallel layers consult it.
 
-Three probe kinds:
+Four probe kinds:
 
 * ``maybe_fail(site)`` — raise an injected exception (an
   :class:`InjectedFault` subclass of a realistic base type such as
@@ -16,7 +16,9 @@ Three probe kinds:
 * ``corrupt(site, data)`` — deterministically truncate or bit-flip a
   byte payload (codec chaos: damaged strips / messages / records);
 * ``degrade(site, value)`` — shrink an integer capacity (collective
-  skew amplification: forces bucket/dup overflow-retry paths).
+  skew amplification: forces bucket/dup overflow-retry paths);
+* ``stall(site)`` — sleep an injected ``delay_ms`` (latency chaos:
+  deterministic slow queries for SLO-alert drills, results intact).
 
 Every decision is a pure function of ``(seed, site, per-site call
 number)`` — re-running the same workload under the same plan injects
@@ -41,7 +43,7 @@ from typing import Dict, List, Optional, Tuple, Type
 from ..obs import metrics
 
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "arm", "disarm",
-           "active", "maybe_fail", "corrupt", "degrade"]
+           "active", "maybe_fail", "corrupt", "degrade", "stall"]
 
 
 class InjectedFault(Exception):
@@ -75,7 +77,7 @@ ERROR_TYPES: Dict[str, Type[BaseException]] = {
     "zlib.error": _zlib.error,
 }
 
-_MODES = ("raise", "truncate", "flip", "degrade")
+_MODES = ("raise", "truncate", "flip", "degrade", "delay")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,8 +88,9 @@ class FaultRule:
     rate: float = 0.0                 # per-call injection probability
     fails: int = 0                    # fail the first N calls instead
     error: Type[BaseException] = OSError
-    mode: str = "raise"               # raise | truncate | flip | degrade
+    mode: str = "raise"       # raise | truncate | flip | degrade | delay
     factor: int = 4                   # degrade: capacity divisor
+    delay_ms: float = 100.0           # delay: injected stall length
 
     def matches(self, site: str) -> bool:
         return fnmatch.fnmatchcase(site, self.pattern)
@@ -116,11 +119,12 @@ class FaultPlan:
 
         ``spec := clause (';' clause)*`` where a clause is ``seed=N``
         or ``site=PATTERN[,rate=F][,fails=N][,error=NAME][,mode=M]
-        [,factor=N]``, e.g.::
+        [,factor=N][,delay_ms=F]``, e.g.::
 
             seed=1234;site=checkpoint.*,rate=0.1,error=OSError;
             site=native.compile,fails=1;
-            site=overlay.*,mode=degrade,rate=1.0,factor=4
+            site=overlay.*,mode=degrade,rate=1.0,factor=4;
+            site=sql.query,mode=delay,fails=1,delay_ms=120
         """
         seed = 0
         rules: List[FaultRule] = []
@@ -155,7 +159,8 @@ class FaultPlan:
                 fails=int(kv.get("fails", 0)),
                 error=ERROR_TYPES[err],
                 mode=mode,
-                factor=int(kv.get("factor", 4))))
+                factor=int(kv.get("factor", 4)),
+                delay_ms=float(kv.get("delay_ms", 100.0))))
         return cls(seed=seed, rules=tuple(rules))
 
     # -- decision core ------------------------------------------------
@@ -228,6 +233,21 @@ class FaultPlan:
                 return max(1, int(value) // max(rule.factor, 1))
         return value
 
+    def stall(self, site: str) -> float:
+        """Sleep ``delay_ms`` when selected (latency chaos: slow
+        queries / SLO drills without breaking results); returns the
+        injected delay in seconds (0.0 = not selected)."""
+        import time as _time
+        n = self._next_call(site)
+        for rule in self.rules:
+            if rule.mode != "delay" or not rule.matches(site):
+                continue
+            if self._hit(rule, site, n):
+                self._record(site, n, "delay")
+                _time.sleep(rule.delay_ms / 1e3)
+                return rule.delay_ms / 1e3
+        return 0.0
+
 
 # ---------------------------------------------------------- module API
 
@@ -269,6 +289,13 @@ def degrade(site: str, value: int) -> int:
     """Probe: shrink a capacity (skew amplification), or pass through."""
     p = _active
     return value if p is None else p.degrade(site, value)
+
+
+def stall(site: str) -> float:
+    """Probe: sleep the armed plan's injected delay, or no-op.
+    Returns the injected seconds (0.0 when disarmed / not selected)."""
+    p = _active
+    return 0.0 if p is None else p.stall(site)
 
 
 # env arming: chaos lanes set MOSAIC_TPU_FAULT_PLAN before pytest

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..core.geometry.array import GeometryArray
 from ..obs import metrics, new_trace, recorder, tracer
+from ..obs.devicemon import devicemon, format_device_ms
 from .parser import (Binary, Call, Column, Literal, Query, SelectItem,
                      Star, Unary, parse)
 from .planner import planner
@@ -213,14 +214,34 @@ class SQLSession:
         by the query's trace id in ``tracer.report()["traces"]`` and
         the Chrome-trace export.  Queries slower than
         ``mosaic.obs.slow.query.ms`` (when > 0) trigger an automatic
-        flight-recorder dump."""
+        flight-recorder dump.
+
+        SLO feed: every call bumps ``sql/queries``; unexpected
+        failures (not :class:`SQLError` — user mistakes are the
+        client's fault, not the service's) bump ``sql/errors``; wall
+        time lands as a ``sql/query_ms`` time-series point so the
+        ``sql_latency`` burn-rate objective sees true per-query
+        latency (``obs.slo``).  The ``sql.query`` fault site injects
+        deterministic stalls for alert drills."""
+        from ..resilience import faults as _faults
         label = " ".join(query.split())[:60]
         t0 = time.perf_counter()
         with new_trace(f"sql:{label}") as ctx:
             recorder.record("sql", query=label)
-            with tracer.span("sql/query"):
-                out = self._sql_impl(query)
+            _faults.stall("sql.query")
+            metrics.count("sql/queries")
+            try:
+                with tracer.span("sql/query"):
+                    out = self._sql_impl(query)
+            except SQLError:
+                raise               # client error: not an SLO fault
+            except Exception:
+                metrics.count("sql/errors")
+                raise
         dt_ms = (time.perf_counter() - t0) * 1e3
+        if metrics.enabled:
+            from ..obs.timeseries import timeseries
+            timeseries.record("sql/query_ms", dt_ms)
         from .. import config as _config
         threshold = _config.default_config().obs_slow_query_ms
         if threshold and dt_ms > threshold:
@@ -267,7 +288,10 @@ class SQLSession:
             # operator row that moved the bytes — zero rows mean the
             # operator never left one device; est_rows is the planner's
             # pre-pass cardinality estimate (-1 = no estimate), placed
-            # next to actual rows so mispredicts read off per operator
+            # next to actual rows so mispredicts read off per operator;
+            # device_ms is the per-device wall-time split the device
+            # monitor attributed while the stage ran ("-" when the
+            # operator never touched a mesh — see obs.devicemon)
             return Table({"operator": [p[0] for p in prof],
                           "detail": [p[1] for p in prof],
                           "rows": np.asarray([p[2] for p in prof],
@@ -279,7 +303,8 @@ class SQLSession:
                           "all_to_all_bytes": np.asarray(
                               [p[4] for p in prof], np.int64),
                           "shard_skew": np.asarray(
-                              [p[5] for p in prof])})
+                              [p[5] for p in prof]),
+                          "device_ms": [p[7] for p in prof]})
         return self._execute(q, None)
 
     def _plan_ops(self, q: Query) -> List[tuple]:
@@ -324,6 +349,8 @@ class SQLSession:
             # nested under the sql/query root span -> qualified as
             # sql/query/<op>, a child in the query's trace tree
             a2a0 = metrics.counter_value("collective/all_to_all_bytes")
+            dev0 = devicemon.busy_by_device() if prof is not None \
+                else None
             with tracer.span(op):
                 t0 = time.perf_counter()
                 res = fn()
@@ -341,9 +368,16 @@ class SQLSession:
                 skew = max((metrics.gauge_value(f"shard/skew/{s}")
                             or 0.0)
                            for s in self._SKEW_SITES) if a2a else 0.0
+                # per-device wall-time split attributed while this
+                # stage ran (sharded ops feed obs.devicemon by load
+                # share) — the EXPLAIN ANALYZE device_ms column
+                dev1 = devicemon.busy_by_device()
+                delta = {k: v - (dev0.get(k, 0.0) if dev0 else 0.0)
+                         for k, v in dev1.items()}
                 prof.append((op, detail, rows, dt, int(a2a),
                              float(skew),
-                             step.est_rows if step is not None else -1))
+                             step.est_rows if step is not None else -1,
+                             format_device_ms(delta)))
             if metrics.enabled:
                 metrics.observe(f"sql/{op}_s", dt)
             return res

@@ -1,0 +1,298 @@
+"""SLO burn-rate alerting and the ops dashboard.
+
+Covers the burn-rate math per objective kind, the exactly-one-alert
+breach-episode contract, the acceptance drill — a fault-plan-injected
+slow query deterministically raises ONE alert (flight-recorder event +
+``obs/alerts_active`` gauge + ``mosaic_slo_*`` OpenMetrics line +
+dashboard JSON) while a clean run raises zero — and the stoppable
+``ServerHandle`` shared by the scrape server and the dashboard.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mosaic_tpu as mos
+from mosaic_tpu.obs import (metrics, recorder, serve_dashboard,
+                            serve_metrics, timeseries, to_openmetrics,
+                            tracer)
+from mosaic_tpu.obs.slo import (SLObjective, SLOMonitor,
+                                default_objectives, monitor)
+from mosaic_tpu.obs.timeseries import TimeSeriesStore
+
+
+@pytest.fixture
+def telemetry():
+    """Fresh global telemetry plane (store + monitor + registry +
+    recorder) for one test; everything restored after."""
+    timeseries.reset()
+    monitor.reset(default_objectives())
+    metrics.reset()
+    metrics.enable()
+    recorder.reset()
+    recorder.enable()
+    tracer.reset()
+    tracer.enable()
+    yield
+    tracer.disable()
+    tracer.reset()
+    recorder.reset()
+    metrics.disable()
+    metrics.reset()
+    monitor.reset(default_objectives())
+    timeseries.reset()
+
+
+@pytest.fixture
+def session():
+    ctx = mos.enable_mosaic("CUSTOM(-180,180,-90,90,2,360,180)")
+    s = mos.SQLSession(ctx)
+    s.create_table("pts", {"x": np.arange(100.0),
+                           "y": np.arange(100.0) / 10.0})
+    return s
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return r.read().decode("utf-8")
+
+
+# --------------------------------------------------- burn-rate math
+
+def test_latency_needs_both_windows_hot():
+    store = TimeSeriesStore()
+    obj = SLObjective(name="lat", kind="latency", series="q_ms",
+                      threshold_ms=100.0, objective=0.95,
+                      windows=(60.0, 300.0))
+    now = 1000.0
+    # long window: 100 good points; short window: 5 bad points
+    for i in range(100):
+        store.record("q_ms", 10.0, ts=700.0 + 2 * i)
+    for i in range(5):
+        store.record("q_ms", 500.0, ts=955.0 + i)
+    res = obj.evaluate(store, now)
+    # short window is fully bad, long window holds under budget
+    assert res["short"] == 1.0
+    assert res["long"] == pytest.approx(5 / 105)
+    assert res["budget"] == pytest.approx(0.05)
+    assert not res["breached"]
+    # more sustained badness pushes the long window over too
+    for i in range(10):
+        store.record("q_ms", 500.0, ts=990.0 + i / 2.0)
+    assert obj.evaluate(store, now)["breached"]
+
+
+def test_error_rate_uses_counter_rates():
+    store = TimeSeriesStore()
+    obj = SLObjective(name="err", kind="error_rate", bad="bad",
+                      total="total", objective=0.90,
+                      windows=(60.0, 300.0))
+    now = 1000.0
+    # total grows 1/s, bad grows 0.04/s -> 4% < 10% budget
+    for i in range(301):
+        store.record("total", float(i), ts=700.0 + i)
+        store.record("bad", 0.04 * i, ts=700.0 + i)
+    res = obj.evaluate(store, now)
+    assert res["short"] == pytest.approx(0.04, rel=1e-6)
+    assert not res["breached"]
+    # bad accelerating to 0.5/s trips both windows
+    store2 = TimeSeriesStore()
+    for i in range(301):
+        store2.record("total", float(i), ts=700.0 + i)
+        store2.record("bad", 0.5 * i, ts=700.0 + i)
+    assert obj.evaluate(store2, now)["breached"]
+
+
+def test_counter_rate_is_a_rate_ceiling():
+    store = TimeSeriesStore()
+    obj = SLObjective(name="storm", kind="counter_rate",
+                      series="compiles", max_rate=2.0,
+                      windows=(60.0, 300.0))
+    now = 1000.0
+    for i in range(301):                    # 5 compiles/s sustained
+        store.record("compiles", 5.0 * i, ts=700.0 + i)
+    res = obj.evaluate(store, now)
+    assert res["short"] == pytest.approx(2.5, rel=1e-6)   # 5/2
+    assert res["breached"]
+    slow = TimeSeriesStore()
+    for i in range(301):                    # 1/s stays under
+        slow.record("compiles", float(i), ts=700.0 + i)
+    assert not obj.evaluate(slow, now)["breached"]
+
+
+def test_gauge_max_is_a_ceiling():
+    store = TimeSeriesStore()
+    obj = SLObjective(name="skew", kind="gauge_max", series="skew",
+                      ceiling=8.0, windows=(60.0, 300.0))
+    now = 1000.0
+    for i in range(301):
+        store.record("skew", 10.0, ts=700.0 + i)
+    assert obj.evaluate(store, now)["breached"]
+    ok = TimeSeriesStore()
+    for i in range(301):
+        ok.record("skew", 3.0, ts=700.0 + i)
+    assert not obj.evaluate(ok, now)["breached"]
+
+
+def test_latency_min_points_floor():
+    store = TimeSeriesStore()
+    obj = SLObjective(name="lat", kind="latency", series="q_ms",
+                      threshold_ms=100.0, objective=0.95,
+                      min_points=3, windows=(60.0, 300.0))
+    store.record("q_ms", 500.0, ts=999.0)
+    store.record("q_ms", 500.0, ts=999.5)
+    # 2 points, 100% bad — but below the evidence floor
+    assert not obj.evaluate(store, 1000.0)["breached"]
+    store.record("q_ms", 500.0, ts=999.8)
+    assert obj.evaluate(store, 1000.0)["breached"]
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        SLObjective(name="x", kind="vibes")
+
+
+# ------------------------------------------ breach-episode contract
+
+def test_monitor_alerts_exactly_once_then_recovers(telemetry):
+    store = TimeSeriesStore()
+    mon = SLOMonitor(objectives=[SLObjective(
+        name="lat", kind="latency", series="q_ms",
+        threshold_ms=100.0, objective=0.95, windows=(60.0, 300.0))],
+        store=store)
+    for i in range(10):
+        store.record("q_ms", 500.0, ts=995.0 + i / 2.0)
+    trans = mon.evaluate(now=1000.0)
+    assert [t["transition"] for t in trans] == ["breach"]
+    assert mon.alerts_active() == 1 and mon.breach_count() == 1
+    assert metrics.gauge_value("obs/alerts_active") == 1.0
+    assert metrics.gauge_value("slo/active/lat") == 1.0
+    assert metrics.counter_value("slo/breaches") == 1
+    # still breached: silent (no alert storm)
+    assert mon.evaluate(now=1001.0) == []
+    assert len(recorder.events("slo_breach")) == 1
+    # data ages out of both windows -> one recovery transition
+    trans = mon.evaluate(now=2000.0)
+    assert [t["transition"] for t in trans] == ["recovery"]
+    assert mon.alerts_active() == 0
+    assert metrics.gauge_value("obs/alerts_active") == 0.0
+    assert metrics.gauge_value("slo/active/lat") == 0.0
+    assert len(recorder.events("slo_recovered")) == 1
+    # breach_count keeps the historical total
+    assert mon.breach_count() == 1
+
+
+def test_monitor_reset_clears_gauges(telemetry):
+    store = TimeSeriesStore()
+    mon = SLOMonitor(objectives=[SLObjective(
+        name="skew", kind="gauge_max", series="s", ceiling=1.0,
+        windows=(60.0, 300.0))], store=store)
+    store.record("s", 5.0, ts=999.0)
+    mon.evaluate(now=1000.0)
+    assert metrics.gauge_value("obs/alerts_active") == 1.0
+    mon.reset()
+    assert mon.alerts_active() == 0
+    assert metrics.gauge_value("obs/alerts_active") == 0.0
+
+
+# --------------------------------------- the acceptance-criteria drill
+
+def test_injected_slow_query_raises_exactly_one_alert(
+        telemetry, session, fault_plan):
+    """A fault-plan delay on ``sql.query`` must deterministically fire
+    ONE sql-latency alert: recorder event, ``obs/alerts_active``
+    gauge, ``mosaic_slo_*`` OpenMetrics lines, dashboard JSON."""
+    monitor.reset([SLObjective(
+        name="sql_latency", kind="latency", series="sql/query_ms",
+        threshold_ms=250.0, objective=0.95, min_points=1,
+        windows=(60.0, 300.0))])
+    fault_plan("site=sql.query,mode=delay,fails=1,delay_ms=500")
+    session.sql("SELECT x FROM pts")         # stalled 500 ms: bad
+    session.sql("SELECT x FROM pts")         # clean: fast
+    trans = monitor.evaluate()
+    assert [t["transition"] for t in trans] == ["breach"]
+    assert [t["name"] for t in trans] == ["sql_latency"]
+    # exactly one: re-evaluating while still breached stays silent
+    assert monitor.evaluate() == []
+    assert len(recorder.events("slo_breach")) == 1
+    assert monitor.alerts_active() == 1
+    assert metrics.gauge_value("obs/alerts_active") == 1.0
+    txt = to_openmetrics()
+    assert "mosaic_slo_active_sql_latency 1" in txt
+    assert "mosaic_slo_breaches_total 1" in txt
+    assert "mosaic_obs_alerts_active 1" in txt
+    # the dashboard reports the same alert over HTTP
+    handle = serve_dashboard(port=0)
+    try:
+        alerts = json.loads(_get(
+            f"http://127.0.0.1:{handle.port}/api/alerts"))
+        assert [a["name"] for a in alerts["active"]] == ["sql_latency"]
+        assert len(alerts["recent_breaches"]) == 1
+        summary = json.loads(_get(
+            f"http://127.0.0.1:{handle.port}/api/summary"))
+        assert summary["alerts_active"] == 1
+    finally:
+        handle.close()
+
+
+def test_clean_run_raises_zero_alerts(telemetry, session, no_faults):
+    """Default objectives + ordinary traffic: nothing fires."""
+    for _ in range(3):
+        session.sql("SELECT x, y FROM pts WHERE x < 50")
+    assert monitor.evaluate() == []
+    assert monitor.alerts_active() == 0
+    assert metrics.gauge_value("obs/alerts_active") == 0.0
+    assert recorder.events("slo_breach") == []
+    assert "mosaic_slo_breaches_total" not in to_openmetrics()
+    # queries did land in the time-series plane
+    assert timeseries.window_stats("sql/query_ms", 300)["count"] == 3
+
+
+# --------------------------------------------- server handle + pages
+
+def test_serve_metrics_handle_start_scrape_stop(telemetry):
+    metrics.count("handle/test", 7)
+    handle = serve_metrics(port=0)
+    try:
+        assert handle.port > 0
+        body = _get(f"http://127.0.0.1:{handle.port}/metrics")
+        assert "mosaic_handle_test_total 7" in body
+    finally:
+        handle.close()
+    handle.close()                            # idempotent
+    with pytest.raises((urllib.error.URLError, ConnectionError,
+                        OSError)):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{handle.port}/metrics", timeout=2)
+
+
+def test_dashboard_endpoints(telemetry, session):
+    session.sql("SELECT x FROM pts")
+    timeseries.record("demo/series", 1.5)
+    handle = serve_dashboard(port=0)
+    base = f"http://127.0.0.1:{handle.port}"
+    try:
+        page = _get(base + "/")
+        assert "ops dashboard" in page and "/api/summary" in page
+        summary = json.loads(_get(base + "/api/summary"))
+        assert summary["metrics_enabled"] is True
+        assert summary["series"] >= 1
+        names = json.loads(_get(base + "/api/series?prefix=demo/"))
+        assert names["names"] == ["demo/series"]
+        ts = json.loads(_get(
+            base + "/api/timeseries?name=demo/series&window=60"))
+        assert ts["found"] and ts["stats"]["count"] == 1
+        missing = json.loads(_get(
+            base + "/api/timeseries?name=nope&window=60"))
+        assert missing["found"] is False
+        for route in ("/api/alerts", "/api/traces", "/api/planner",
+                      "/api/devices", "/metrics"):
+            assert _get(base + route)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=5)
+    finally:
+        handle.close()

@@ -383,6 +383,10 @@ def test_explain_and_explain_analyze(session):
     # single-device queries carry the sharded columns as zeros
     assert agg.columns["all_to_all_bytes"].tolist() == [0, 0]
     assert agg.columns["shard_skew"].tolist() == [0.0, 0.0]
+    # ... and an empty per-device attribution cell ("-"): nothing
+    # charged busy time to a device during these host-only stages
+    assert list(agg.columns["device_ms"]) == ["-", "-"]
+    assert len(out.columns["device_ms"]) == len(ops)
 
 
 def test_explain_analyze_sharded_columns(session, mc):
@@ -407,6 +411,14 @@ def test_explain_analyze_sharded_columns(session, mc):
         assert out.columns["shard_skew"][proj] >= 1.0
         assert out.columns["all_to_all_bytes"][scan] == 0
         assert out.columns["shard_skew"][scan] == 0.0
+        # per-device wall-time attribution (obs.devicemon): the
+        # overlay charged its wall clock to mesh devices during the
+        # projection, so that row's device_ms cell names devices;
+        # the scan attributed nothing
+        assert out.columns["device_ms"][proj] != "-"
+        import re as _re
+        assert _re.search(r"cpu:\d+=\d", out.columns["device_ms"][proj])
+        assert out.columns["device_ms"][scan] == "-"
         # and the distributed operator still computes the right answer
         res = session.sql("SELECT grid_intersects_sharded(ga, gb, 2) "
                           "AS hit FROM shpairs")

@@ -1,0 +1,235 @@
+"""Bench-trajectory watchdog: regression + anomaly analysis over the
+``BENCH_r*.json`` history.
+
+The perf guard in bench.py answers one binary question per run — "did
+any tracked metric slip >20% against the median of the last 3
+same-platform records?".  This watchdog reads the SAME trajectory but
+reports more:
+
+* **regressions** — the guard's median-of-last-3 comparison, repeated
+  here so the markdown report is self-contained;
+* **variance spikes** — a metric whose current value sits far outside
+  the historical spread (``|current - median| > var_factor * stdev``)
+  even when it has not crossed the 20% slip line; a noisy metric is a
+  warning that the NEXT guard verdict may be a coin flip;
+* **trends** — per-metric trajectory (oldest -> newest -> current) so
+  a slow drift that never trips the per-round guard is visible.
+
+History tolerance: an empty history yields status ``no-history`` and
+a single record yields ``short-history`` — both report trends only
+(no stdev exists to flag spikes against, no meaningful median to call
+regressions against with one point) and never raise.
+
+Standalone by design: the metric lists are local copies of the bench
+perf-guard lists, so importing this module never imports bench.py
+(whose import pulls the whole mosaic stack).  Run as a CLI it analyzes
+the newest record against the rest::
+
+    python tools/bench_watchdog.py [--platform cpu] [--dir PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LOWER_BETTER", "HIGHER_BETTER", "load_history", "analyze",
+           "to_markdown", "main"]
+
+# Local copies of bench.perf_guard's metric direction lists (kept in
+# sync by tests/test_timeseries.py::test_watchdog_metric_lists).
+LOWER_BETTER = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
+                "planner_flagship_ms",
+                "sharded_end_to_end_ms",
+                "tessellate_zones_s",
+                "tessellate_counties_s", "overlay_s",
+                "overlay_area_s", "real_zones_join_s",
+                "union_agg_s",
+                "raster_to_grid_s"]
+HIGHER_BETTER = ["value", "knn_rows_per_sec", "sharded_pts_per_sec"]
+
+
+def _num(rec: dict, key: str) -> Optional[float]:
+    v = rec.get(key)
+    return float(v) if isinstance(v, (int, float)) and v else None
+
+
+def _unwrap(rec: dict) -> Optional[dict]:
+    """A BENCH file is either the bench record itself or a runner
+    wrapper ``{"n", "cmd", "rc", "tail"}`` whose ``tail`` captures the
+    bench stdout — the record is then the last JSON line inside it."""
+    if not isinstance(rec, dict):
+        return None
+    if "metric" in rec or "platform" in rec:
+        return rec
+    tail = rec.get("tail")
+    if not isinstance(tail, str):
+        return None
+    found = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and ("metric" in obj
+                                      or "platform" in obj):
+            found = obj
+    return found
+
+
+def load_history(directory: str,
+                 platform: Optional[str] = None
+                 ) -> List[Tuple[str, dict]]:
+    """``(round_tag, record)`` pairs from ``BENCH_r*.json`` under
+    ``directory``, oldest first, optionally filtered to one platform.
+    Unreadable/empty files are skipped, mirroring the bench guard."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                raw = f.read().strip()
+            try:            # whole-file JSON (pretty-printed records)
+                rec = json.loads(raw)
+            except ValueError:  # JSONL: newest record is the last line
+                rec = json.loads(raw.splitlines()[-1])
+        except (OSError, ValueError, IndexError):
+            continue
+        rec = _unwrap(rec)
+        if rec is None:
+            continue
+        if platform is not None and rec.get("platform") != platform:
+            continue
+        m = re.search(r"BENCH_r(\d+)", path)
+        out.append((m.group(1) if m else path, rec))
+    return out
+
+
+def analyze(history: List[Tuple[str, dict]], current: dict,
+            slip: float = 0.20, window: int = 3,
+            var_factor: float = 3.0) -> dict:
+    """Compare ``current`` against the ``history`` trajectory.
+
+    ``history`` is ``(tag, record)`` pairs oldest first (the shape
+    :func:`load_history` returns; bare record dicts are accepted too).
+    Returns ``{"status", "regressions", "variance_spikes", "trends",
+    "flags"}`` where ``flags`` is the flat human-readable union the
+    caller can log line by line.  Never raises on thin history."""
+    hist: List[Tuple[str, dict]] = [
+        h if isinstance(h, tuple) else (str(i), h)
+        for i, h in enumerate(history)]
+    status = ("no-history" if not hist
+              else "short-history" if len(hist) < 2 else "ok")
+    recent = hist[-window:]
+    tags = "+".join(t for t, _ in recent)
+
+    regressions: List[str] = []
+    spikes: List[str] = []
+    trends: Dict[str, dict] = {}
+    for key in LOWER_BETTER + HIGHER_BETTER:
+        lower = key in LOWER_BETTER
+        cur = _num(current, key)
+        traj = [v for v in (_num(r, key) for _, r in hist)
+                if v is not None]
+        if cur is None and not traj:
+            continue
+        trends[key] = {
+            "history": [round(v, 3) for v in traj],
+            "current": round(cur, 3) if cur is not None else None,
+            "direction": "lower_better" if lower else "higher_better",
+        }
+        if cur is None:
+            continue
+        base_vals = [v for v in (_num(r, key) for _, r in recent)
+                     if v is not None]
+        if base_vals:
+            base = statistics.median(base_vals)
+            trends[key]["baseline"] = round(base, 3)
+            ratio = cur / base if base else None
+            if ratio is not None and (
+                    ratio > 1.0 + slip if lower else ratio < 1.0 - slip):
+                regressions.append(
+                    f"{key}: median {base:g} -> {cur:g} "
+                    f"({(ratio - 1) * 100:+.0f}% vs r{tags})")
+        # variance spike: needs a real spread to measure against
+        if len(traj) >= 3:
+            med = statistics.median(traj)
+            sd = statistics.stdev(traj)
+            if sd > 0 and abs(cur - med) > var_factor * sd:
+                spikes.append(
+                    f"{key}: {cur:g} is {abs(cur - med) / sd:.1f} "
+                    f"stdevs from history median {med:g} "
+                    f"(stdev {sd:g}, n={len(traj)})")
+
+    return {
+        "status": status,
+        "window": len(recent),
+        "regressions": regressions,
+        "variance_spikes": spikes,
+        "trends": trends,
+        "flags": ([f"regression: {m}" for m in regressions] +
+                  [f"variance spike: {m}" for m in spikes]),
+    }
+
+
+def to_markdown(report: dict, platform: str = "?") -> str:
+    """Render an :func:`analyze` report as a small markdown document."""
+    lines = [f"# Bench watchdog ({platform})", ""]
+    lines.append(f"History status: **{report['status']}** "
+                 f"(guard window {report['window']})")
+    lines.append("")
+    for title, items in (("Regressions", report["regressions"]),
+                         ("Variance spikes",
+                          report["variance_spikes"])):
+        lines.append(f"## {title}")
+        if items:
+            lines.extend(f"- {m}" for m in items)
+        else:
+            lines.append("- none")
+        lines.append("")
+    lines.append("## Trends")
+    lines.append("")
+    lines.append("| metric | direction | history | baseline | current |")
+    lines.append("|---|---|---|---|---|")
+    for key, t in sorted(report["trends"].items()):
+        hist = " ".join(f"{v:g}" for v in t["history"]) or "-"
+        base = t.get("baseline")
+        lines.append(
+            f"| {key} | {t['direction']} | {hist} | "
+            f"{base if base is not None else '-'} | "
+            f"{t['current'] if t['current'] is not None else '-'} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--platform", default=None,
+                    help="restrict to one platform tag (cpu/tpu)")
+    ap.add_argument("--slip", type=float, default=0.20)
+    args = ap.parse_args(argv)
+    hist = load_history(args.dir, args.platform)
+    if not hist:
+        print(f"# Bench watchdog\n\nno BENCH_r*.json records under "
+              f"{args.dir}")
+        return 0
+    tag, current = hist[-1]
+    report = analyze(hist[:-1], current, slip=args.slip)
+    platform = current.get("platform", args.platform or "?")
+    print(to_markdown(report, platform=f"{platform}, newest r{tag}"))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
