@@ -39,6 +39,22 @@ MOSAIC_OBS_SAMPLE_MS = "mosaic.obs.sample.ms"
 # (obs/slo.py); off by default — breaches always raise the recorder
 # event + gauges regardless.
 MOSAIC_OBS_SLO_DUMP = "mosaic.obs.slo.dump"
+# Sampling host-profiler rate in Hz (obs/profiler.py): > 0 runs a
+# daemon thread walking sys._current_frames() at that rate, folding
+# samples into collapsed-stack counts with per-trace attribution; 0
+# (the default — off in prod, bench.py turns it on) keeps it off.
+# Env var MOSAIC_TPU_PROFILE_HZ pins the rate over this key.
+MOSAIC_OBS_PROFILE_HZ = "mosaic.obs.profile.hz"
+# Cooldown between AUTOMATIC flight-recorder dumps (slow-query and
+# SLO-breach triggers share one gate — obs/recorder.py
+# dump_throttled); dumps held by the gate raise a dump_suppressed
+# event instead.  0 disables the gate (every trigger dumps).
+MOSAIC_OBS_DUMP_COOLDOWN_MS = "mosaic.obs.dump.cooldown.ms"
+# Bounded jax.profiler device-trace capture on triggered dumps
+# (obs/profiler.py maybe_device_capture): > 0 records that many
+# milliseconds of XLA timeline into the dump dir on each allowed
+# auto-dump; 0 (default) disables the capture.
+MOSAIC_OBS_PROFILE_TRACE_MS = "mosaic.obs.profile.trace.ms"
 MOSAIC_CRS_STRICT_DATUM = "mosaic.crs.strict.datum"
 # Precision-policy keys (fields existed since round 1; the conf spelling
 # maps onto them so conf-driven deployments can set the policy too).
@@ -118,6 +134,15 @@ class MosaicConfig:
     obs_sample_ms: float = 0.0
     # Dump a flight bundle whenever an SLO objective newly breaches.
     obs_slo_dump: bool = False
+    # Sampling host-profiler rate (Hz); 0 (default) = no profiler
+    # thread.  bench.py starts one explicitly for every run.
+    obs_profile_hz: float = 0.0
+    # Minimum spacing between automatic dump-bundle writes (slow-query
+    # + SLO triggers share the gate); 0 disables the cooldown.
+    obs_dump_cooldown_ms: float = 30_000.0
+    # Bounded device-profiler capture on triggered dumps (ms of
+    # jax.profiler timeline); 0 disables.
+    obs_profile_trace_ms: float = 0.0
     # Raise (instead of warn) when a CRS transform would silently apply
     # an identity datum shift because the EPSG registry carries no
     # Helmert parameters for the code (helmert_acc is NaN).
@@ -214,6 +239,17 @@ def _as_millis(key: str, value) -> float:
     return ms
 
 
+def _as_hz(key: str, value) -> float:
+    try:
+        hz = float(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not a rate in Hz") from None
+    if hz < 0:
+        raise ConfigError(f"{key}={hz} must be >= 0 (0 disables)")
+    return hz
+
+
 def _as_str(key: str, value) -> str:
     return str(value)
 
@@ -248,6 +284,9 @@ _CONF_FIELDS = {
     MOSAIC_OBS_SLOW_QUERY_MS: ("obs_slow_query_ms", _as_millis),
     MOSAIC_OBS_SAMPLE_MS: ("obs_sample_ms", _as_millis),
     MOSAIC_OBS_SLO_DUMP: ("obs_slo_dump", _as_flag),
+    MOSAIC_OBS_PROFILE_HZ: ("obs_profile_hz", _as_hz),
+    MOSAIC_OBS_DUMP_COOLDOWN_MS: ("obs_dump_cooldown_ms", _as_millis),
+    MOSAIC_OBS_PROFILE_TRACE_MS: ("obs_profile_trace_ms", _as_millis),
     MOSAIC_CRS_STRICT_DATUM: ("crs_strict_datum", _as_flag),
     MOSAIC_IO_ON_ERROR: ("io_on_error", _as_on_error),
     MOSAIC_JIT_CACHE_DIR: ("jit_cache_dir", _as_str),
@@ -324,12 +363,15 @@ def set_default_config(cfg: MosaicConfig) -> None:
     # instrument the env or an explicit enable() already turned on).
     # The sampler cadence routes through here too (change-detecting,
     # env-pinned-safe — see obs.timeseries.configure_sampler).
-    if cfg.trace_enabled or cfg.metrics_enabled or cfg.obs_sample_ms:
+    if cfg.trace_enabled or cfg.metrics_enabled or cfg.obs_sample_ms \
+            or cfg.obs_profile_hz:
         from .obs import configure
         configure(cfg)
     else:
         from .obs.timeseries import configure_sampler
         configure_sampler(0.0)
+        from .obs.profiler import configure_profiler
+        configure_profiler(0.0)
     if cfg.jit_cache_dir:
         from .perf.jit_cache import configure_persistent_cache
         configure_persistent_cache(cfg.jit_cache_dir)

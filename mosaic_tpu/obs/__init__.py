@@ -1,7 +1,7 @@
 """Observability subsystem: metrics, tracing, flight recorder, export.
 
 Grown out of ``mosaic_tpu.utils.trace`` (which remains as a compat
-shim).  Eleven parts:
+shim).  Twelve parts:
 
 * ``obs.metrics`` — process-global registry of counters, gauges, and
   exponential-bucket histograms (p50/p95/p99 derivable).
@@ -34,6 +34,11 @@ shim).  Eleven parts:
   share (feeds the EXPLAIN ANALYZE ``device_ms`` column).
 * ``obs.dashboard`` — the live ops dashboard: JSON endpoints +
   a self-contained polling HTML page (``serve_dashboard(port)``).
+* ``obs.profiler`` — the continuous profiling plane: sampling host
+  profiler (collapsed stacks with per-trace attribution,
+  ``mosaic.obs.profile.hz`` / ``MOSAIC_TPU_PROFILE_HZ``), the
+  per-kernel device-cost ledger, and triggered capture into flight
+  bundles (plus speedscope export and the ``/profile`` flamegraph).
 
 The tracer and registry are disabled by default and cost one attribute
 check per instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
@@ -59,6 +64,9 @@ from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
                      sample_memory)
 from .metrics import Histogram, MetricsRegistry, metrics
 from .openmetrics import ServerHandle, serve_metrics, to_openmetrics
+from .profiler import (HostProfiler, KernelLedger, capture_snapshot,
+                       configure_profiler, ledger, maybe_device_capture,
+                       profiler, start_profiler, stop_profiler)
 from .recorder import FlightRecorder, install_excepthook, recorder
 from .slo import SLObjective, SLOMonitor, default_objectives, monitor
 from .timeseries import (Sampler, TimeSeriesStore, configure_sampler,
@@ -83,6 +91,9 @@ __all__ = [
     "SLObjective", "SLOMonitor", "monitor", "default_objectives",
     "DeviceMonitor", "devicemon", "mesh_device_keys",
     "serve_dashboard",
+    "HostProfiler", "KernelLedger", "ledger", "profiler",
+    "start_profiler", "stop_profiler", "configure_profiler",
+    "capture_snapshot", "maybe_device_capture",
     "configure",
 ]
 
@@ -105,6 +116,18 @@ if _env_ms:
         metrics.enable()
         start_sampler(_ms)
 
+# Env-pinned host profiler: MOSAIC_TPU_PROFILE_HZ=<hz> starts the
+# sampling profiler at import (and pins the rate against conf changes
+# — see profiler.configure_profiler).
+_env_hz = _os.environ.get("MOSAIC_TPU_PROFILE_HZ", "").strip()
+if _env_hz:
+    try:
+        _hz = float(_env_hz)
+    except ValueError:
+        _hz = 0.0
+    if _hz > 0:
+        start_profiler(_hz)
+
 
 def configure(config) -> None:
     """Apply a ``MosaicConfig``'s observability switches (idempotent).
@@ -124,3 +147,6 @@ def configure(config) -> None:
         if ms > 0:        # a sampler over a disabled registry records
             metrics.enable()   # nothing — the cadence implies metrics
         configure_sampler(ms)
+    hz = getattr(config, "obs_profile_hz", None)
+    if hz is not None:
+        configure_profiler(hz)

@@ -17,6 +17,9 @@ Routes:
 * ``/api/traces``       — recent completed trace trees (tracer on)
 * ``/api/planner``      — planner decisions/coefficients report
 * ``/api/devices``      — per-device attribution (``obs.devicemon``)
+* ``/api/profile``      — profiler snapshot: host stacks (``?trace=``
+  filters to one trace context), kernel ledger, collapsed text
+* ``/profile``          — the flamegraph view over ``/api/profile``
 
 ``serve_dashboard(port=0)`` returns the same stoppable
 :class:`~.openmetrics.ServerHandle` as ``serve_metrics`` — close it
@@ -123,6 +126,27 @@ def _devices_payload() -> Dict[str, object]:
     return devicemon.report()
 
 
+def _profile_payload(qs: Dict[str, list]) -> Dict[str, object]:
+    from .profiler import ledger, profiler
+    trace = (qs.get("trace") or [None])[0] or None
+    p = profiler()
+    out: Dict[str, object] = {
+        "running": p is not None and p.alive,
+        "ledger": ledger.report(),
+    }
+    if p is not None:
+        rep = p.report(max_stacks=_MAX_POINTS)
+        if trace:
+            rep["stacks"] = [s for s in rep["stacks"]
+                             if s["trace"] == trace]
+        out["host"] = rep
+        out["collapsed"] = p.collapsed(trace)
+    else:
+        out["host"] = {}
+        out["collapsed"] = ""
+    return out
+
+
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>mosaic_tpu ops</title>
 <style>
@@ -136,6 +160,8 @@ _PAGE = """<!doctype html>
  svg{border:1px solid #ddd;background:#fafafa}
 </style></head><body>
 <h1>mosaic_tpu ops dashboard</h1>
+<p><a href="/profile">profiler / flamegraph</a> ·
+ <a href="/metrics">openmetrics</a></p>
 <div id="summary">loading…</div>
 <h2>Active alerts</h2><ul id="alerts"><li class="ok">none</li></ul>
 <h2>Series <select id="pick"></select>
@@ -187,6 +213,78 @@ tick();setInterval(tick,2000);
 </script></body></html>
 """
 
+# The flamegraph view: folds /api/profile's collapsed stacks into a
+# trie client-side and renders one SVG rect per node (width = sample
+# share, icicle layout, root on top).  Same zero-dependency rules as
+# the main page: inline HTML, stdlib server, fetch() polling.
+_PROFILE_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>mosaic_tpu profile</title>
+<style>
+ body{font:13px/1.5 system-ui,sans-serif;margin:1.5em;max-width:80em}
+ h1{font-size:1.2em} h2{font-size:1em;margin:1.2em 0 .3em}
+ table{border-collapse:collapse} td,th{padding:.15em .7em;
+  border-bottom:1px solid #ddd;text-align:left;font-variant-numeric:
+  tabular-nums}
+ svg{border:1px solid #ddd;background:#fafafa;width:100%}
+ svg text{font:10px monospace;pointer-events:none}
+ #meta{color:#666}
+</style></head><body>
+<h1>mosaic_tpu profile <a href="/" style="font-size:.7em">(dashboard)
+</a></h1>
+<div id="meta">loading…</div>
+<h2>Flame graph (host samples) <select id="trace"></select></h2>
+<svg id="fg" height="0"></svg>
+<h2>Kernel ledger</h2><table id="ledger"></table>
+<script>
+const $=id=>document.getElementById(id);
+async function j(u){const r=await fetch(u);return r.json()}
+function fold(stacks){const root={n:"all",v:0,c:{}};
+ for(const s of stacks){root.v+=s.count;let cur=root;
+  for(const f of s.frames){cur=cur.c[f]||(cur.c[f]={n:f,v:0,c:{}});
+   cur.v+=s.count}}
+ return root}
+function render(root){const W=1200,H=16,rows=[];
+ (function walk(node,x,d){rows.push([node,x,d]);let cx=x;
+  for(const k of Object.keys(node.c).sort())
+   {walk(node.c[k],cx,d+1);cx+=node.c[k].v}})(root,0,0);
+ const depth=Math.max(...rows.map(r=>r[2]))+1;
+ const sv=$("fg");sv.setAttribute("viewBox","0 0 "+W+" "+depth*H);
+ sv.setAttribute("height",depth*H);
+ sv.innerHTML=rows.map(([n,x,d])=>{const w=W*n.v/(root.v||1);
+  if(w<1)return"";const px=W*x/(root.v||1);
+  const hue=(n.n.split("").reduce((a,c)=>a+c.charCodeAt(0),0)%60)+10;
+  return '<g><title>'+n.n+' ('+n.v+' samples)</title>'+
+   '<rect x="'+px+'" y="'+d*H+'" width="'+Math.max(w-.5,.5)+
+   '" height="'+(H-1)+'" fill="hsl('+hue+',70%,72%)"/>'+
+   (w>60?'<text x="'+(px+3)+'" y="'+(d*H+H-5)+'">'+
+    n.n.replace(/&/g,"&amp;").replace(/</g,"&lt;")
+     .slice(0,Math.floor(w/7))+'</text>':'')+'</g>'}).join("")}
+async function tick(){
+ const sel=$("trace"),cur=sel.value;
+ const p=await j("/api/profile"+(cur&&cur!=="(all)"?
+  "?trace="+encodeURIComponent(cur):""));
+ const h=p.host||{};
+ $("meta").textContent=p.running?
+  "sampler on @ "+h.hz+" Hz — "+h.samples+" samples, "+
+  h.distinct_stacks+" distinct stacks, "+h.truncated+" truncated":
+  "host sampler off (start_profiler() / MOSAIC_TPU_PROFILE_HZ) — "+
+  "ledger below is always on";
+ const traces=Object.entries(h.traces||{});
+ sel.innerHTML=["(all)",...traces.map(([t,i])=>t)].map(t=>
+  "<option"+(t===cur?" selected":"")+">"+t+"</option>").join("");
+ render(fold(h.stacks||[]));
+ const L=p.ledger||{kernels:[]};
+ $("ledger").innerHTML="<tr><th>kernel</th><th>key</th>"+
+  "<th>launches</th><th>seconds</th><th>rows/s</th><th>gflops/s</th>"+
+  "</tr>"+L.kernels.map(k=>"<tr><td>"+k.name+"</td><td><code>"+
+   k.key.slice(0,60)+"</code></td><td>"+k.launches+"</td><td>"+
+   k.seconds.toFixed(4)+"</td><td>"+(k.rows_per_s||"-")+"</td><td>"+
+   (k.gflops_s||"-")+"</td></tr>").join("");
+}
+tick();setInterval(tick,3000);
+</script></body></html>
+"""
+
 
 def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     ) -> ServerHandle:
@@ -230,6 +328,11 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     self._json(_planner_payload())
                 elif path == "/api/devices":
                     self._json(_devices_payload())
+                elif path == "/api/profile":
+                    self._json(_profile_payload(qs))
+                elif path == "/profile":
+                    self._send(_PROFILE_PAGE.encode(),
+                               "text/html; charset=utf-8")
                 else:
                     self.send_error(404)
             except BrokenPipeError:

@@ -180,6 +180,14 @@ def record_cost_analysis(label: str, compiled) -> Dict[str, float]:
     if out:
         recorder.record("xla_cost", label=label,
                         **{k: v for k, v in out.items()})
+        try:
+            # join the cost model into the kernel ledger: any ledger
+            # row whose name matches this label gains flops/bytes (and
+            # with observed launch times, derived gflops/s)
+            from .profiler import ledger
+            ledger.record_cost(label, out)
+        except Exception:
+            pass
     return out
 
 

@@ -81,6 +81,9 @@ class FlightRecorder:
             collections.deque(maxlen=max(16, cap))
         self._seq = 0
         self._dumps = 0
+        self._dropped = 0            # events evicted off the ring
+        self._last_auto_dump: Optional[float] = None
+        self._suppressed = 0         # auto-dumps held by the cooldown
 
     # -- switches
     def enable(self) -> None:
@@ -107,6 +110,9 @@ class FlightRecorder:
             else:
                 self._events.clear()
             self._seq = 0
+            self._dropped = 0
+            self._last_auto_dump = None
+            self._suppressed = 0
 
     # -- the probe
     def record(self, kind: str, **fields) -> None:
@@ -122,7 +128,22 @@ class FlightRecorder:
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq
+            # a full ring wraps silently at append — count the
+            # eviction so truncated flight recordings are detectable
+            dropping = len(self._events) == self._events.maxlen
+            if dropping:
+                self._dropped += 1
             self._events.append(ev)
+        if dropping:
+            from .metrics import metrics
+            if metrics.enabled:
+                metrics.count("obs/recorder_dropped")
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted off the ring since the last reset."""
+        with self._lock:
+            return self._dropped
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
         """Snapshot of retained events, oldest first (optionally
@@ -157,14 +178,21 @@ class FlightRecorder:
             mem = last_watermarks()
         except Exception:
             mem = {}
+        try:                    # triggered capture: host stacks + the
+            from .profiler import capture_snapshot   # kernel ledger
+            prof = capture_snapshot()                # ride along in
+        except Exception:                            # every bundle
+            prof = {}
         b: Dict[str, Any] = {
             "reason": reason,
             "ts": time.time(),
             "pid": os.getpid(),
             "events": self.events(),
+            "dropped": self.dropped,
             "metrics": metrics.report(),
             "timeseries": ts_snap,
             "memory": mem,
+            "profile": prof,
             "config": cfg,
             "jax": _jax_info(),
         }
@@ -191,6 +219,50 @@ class FlightRecorder:
         os.replace(tmp, path)
         self.record("dump", path=path, reason=reason)
         return path
+
+    def dump_throttled(self, reason: str = "auto",
+                       error: Optional[str] = None) -> Optional[str]:
+        """Cooldown-gated :meth:`dump` shared by every automatic
+        trigger (slow queries AND SLO breach dumps — a sustained slow
+        workload must not become a dump storm).
+
+        At most one dump per ``mosaic.obs.dump.cooldown.ms`` (default
+        30 s; 0 disables the gate).  A held dump returns None and
+        records a ``dump_suppressed`` event carrying how many dumps
+        the cooldown has swallowed since the last one that went
+        through; an allowed dump's bundle likewise carries the count.
+        Also fires the optional bounded device-profiler capture
+        (``obs.profiler.maybe_device_capture``) on allowed dumps."""
+        try:
+            from .. import config as _config
+            cd_ms = float(getattr(_config.default_config(),
+                                  "obs_dump_cooldown_ms", 30_000.0))
+        except Exception:
+            cd_ms = 30_000.0
+        now = time.time()
+        with self._lock:
+            held = (cd_ms > 0 and self._last_auto_dump is not None
+                    and (now - self._last_auto_dump) * 1e3 < cd_ms)
+            if held:
+                self._suppressed += 1
+                suppressed = self._suppressed
+            else:
+                self._last_auto_dump = now
+                suppressed = self._suppressed
+                self._suppressed = 0
+        if held:
+            self.record("dump_suppressed", reason=reason,
+                        suppressed=suppressed, cooldown_ms=cd_ms)
+            return None
+        if suppressed:
+            self.record("dump_suppressed_flush", reason=reason,
+                        suppressed=suppressed)
+        try:
+            from .profiler import maybe_device_capture
+            maybe_device_capture(reason)
+        except Exception:
+            pass
+        return self.dump(reason=reason, error=error)
 
     @contextlib.contextmanager
     def dump_on_error(self, reason: str = "unhandled_error"):

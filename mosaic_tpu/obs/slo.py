@@ -228,8 +228,11 @@ class SLOMonitor:
         metrics.gauge(f"slo/active/{obj.name}", 1.0)
         from .. import config as _config
         if getattr(_config.default_config(), "obs_slo_dump", False):
+            # throttled: shares the mosaic.obs.dump.cooldown.ms gate
+            # with slow-query dumps (no dump storms under sustained
+            # breach churn); the bundle embeds the profiler snapshot
             try:
-                recorder.dump(reason=f"slo_{obj.name}")
+                recorder.dump_throttled(reason=f"slo_{obj.name}")
             except OSError:
                 pass
 

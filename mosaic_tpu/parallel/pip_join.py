@@ -333,13 +333,21 @@ def make_streamed_pip_join(idx, grid: IndexSystem,
     Returns ``run(points64_abs) -> (zone [N] int32, rechecked
     count)``."""
     chunk = _resolve_chunk(chunk)
-    fn = jax.jit(make_pip_join_fn(idx, grid, eps, margin_eps, precision))
+    from ..perf.jit_cache import kernel_cache
+    # named jit-cache entry (not a bare jax.jit) so the kernel ledger
+    # can attribute the streamed join's wall time to "pip/streamed"
+    fn = kernel_cache.get_or_build(
+        "pip/streamed", (id(idx), id(grid), eps, margin_eps, precision),
+        lambda: jax.jit(
+            make_pip_join_fn(idx, grid, eps, margin_eps, precision)))
     recheck = host_recheck_fn(idx, polys)
     origin = np.asarray(idx.origin)
+    ledger_key = (id(idx), id(grid), eps, margin_eps, precision)
 
     def run(points64: np.ndarray):
         from ..obs import metrics, tracer
         from ..obs.context import root_trace
+        from ..obs.profiler import ledger
         points64 = np.asarray(points64, np.float64)[:, :2]
         n = len(points64)
         zone_out = np.empty(n, np.int32)
@@ -356,9 +364,13 @@ def make_streamed_pip_join(idx, grid: IndexSystem,
             zone_out[sl] = recheck(points64[sl], z, unc)
             state["rechecked"] += int(unc.sum())
 
+        def observe(i, sl, seconds):
+            ledger.observe("pip/streamed", ledger_key, seconds,
+                           rows=sl.stop - sl.start)
+
         with root_trace("pip_join"), tracer.span("pip_join/streamed"):
             stream(chunk_rows(n, chunk), compute=fn, put=put,
-                   consume=consume)
+                   consume=consume, observe=observe)
         if metrics.enabled:
             metrics.count("pip_join/streamed_points", float(n))
             metrics.count("pip_join/streamed_chunks",
@@ -445,6 +457,10 @@ def make_sharded_pip_join(idx, grid: IndexSystem, mesh,
             t0 = _time.perf_counter()
             out = jfn(points)
             dt = _time.perf_counter() - t0
+        from ..obs.profiler import ledger
+        ledger.observe("pip/sharded_wrap",
+                       (id(idx), id(mesh), axis, eps, margin_eps),
+                       dt, rows=int(points.shape[0]))
         if metrics.enabled:
             metrics.gauge("collective/replicated_index_bytes",
                           float(idx_bytes) * D)
@@ -581,12 +597,22 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
                 metrics.gauge("shard/skew_planned/pip_join",
                               rebalancer.planned_skew())
 
+        def observe(i, sl, seconds):
+            from ..obs.profiler import ledger
+            rows = sl.stop - sl.start
+            padded = pow2_bucket(-(-rows // D), floor=64) * D
+            # same key shape as the kernel() cache entry, so the ledger
+            # row lines up with the per-bucket jit-cache kernel
+            ledger.observe("pip/sharded_stream",
+                           (id(idx), id(mesh), axis, padded, eps,
+                            margin_eps), seconds, rows=rows)
+
         import time as _time
         t0 = _time.perf_counter()
         with root_trace("pip_join"), \
                 tracer.span("pip_join/sharded_streamed"):
             stream(chunk_rows(n, chunk), compute=compute, put=put,
-                   consume=consume)
+                   consume=consume, observe=observe)
         if metrics.enabled:
             # per-device wall-time attribution: the run's matched-row
             # counts per shard (summed over chunks) are the load share

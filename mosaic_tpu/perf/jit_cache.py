@@ -86,6 +86,15 @@ class JitCache:
             if metrics.enabled:
                 metrics.count("perf/jit_cache/miss")
                 metrics.count(f"perf/jit_cache/miss/{name}")
+            try:
+                # seed a kernel-ledger row so every named cache entry
+                # shows up in profiler reports even before its first
+                # observed launch (lazy import: perf must not require
+                # the profiler at import time)
+                from ..obs.profiler import ledger
+                ledger.register(name, key)
+            except Exception:
+                pass
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
                 self.evictions += 1

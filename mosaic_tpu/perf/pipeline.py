@@ -71,7 +71,8 @@ def _to_host(out):
 
 def stream(chunks: Sequence, compute: Callable,
            put: Optional[Callable] = None,
-           consume: Optional[Callable] = None) -> list:
+           consume: Optional[Callable] = None,
+           observe: Optional[Callable] = None) -> list:
     """Run ``chunks`` through the double-buffered pipeline; returns the
     per-chunk results in order.
 
@@ -82,18 +83,34 @@ def stream(chunks: Sequence, compute: Callable,
     numpy, runs on the worker thread in chunk order).  Without
     ``consume`` the host-fetched outputs themselves are returned.
 
+    ``observe(i, payload, seconds)`` (optional) receives each chunk's
+    launch wall time — compute dispatch to host-fetch completion,
+    clamped to the previous chunk's completion so the per-chunk spans
+    are disjoint and sum to (at most, and in steady state almost
+    exactly) the pipeline's busy wall time.  This is the kernel
+    ledger's wall-time feed (``obs.profiler``); callbacks run on the
+    single worker thread, in chunk order, and must not raise.
+
     Exceptions from any stage propagate to the caller; the worker is
     drained first so no device work is abandoned mid-flight."""
     chunks = list(chunks)
     if not chunks:
         return []
+    import time as _time
     import jax
     if put is None:
         put = jax.device_put
+    dispatch_ts: list = [0.0] * len(chunks)
+    obs_state = {"last_done": 0.0}
 
     def fetch(i, payload, out):
         faults.maybe_fail("pipeline.fetch")
         host = _to_host(out)        # blocks the WORKER until ready
+        if observe is not None:     # single worker: in-order, race-free
+            now = _time.perf_counter()
+            start = max(dispatch_ts[i], obs_state["last_done"])
+            obs_state["last_done"] = now
+            observe(i, payload, now - start)
         if metrics.enabled:         # device->host drain, per chunk
             metrics.count("pipeline/d2h_bytes", _tree_bytes(host))
         return consume(i, payload, host) if consume is not None \
@@ -110,6 +127,7 @@ def stream(chunks: Sequence, compute: Callable,
         futs = []
         dev = staged(chunks[0])
         for i, payload in enumerate(chunks):
+            dispatch_ts[i] = _time.perf_counter()
             out = compute(dev)
             if i + 1 < len(chunks):
                 dev = staged(chunks[i + 1])  # overlap H2D with compute

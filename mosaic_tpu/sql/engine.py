@@ -248,8 +248,14 @@ class SQLSession:
             recorder.record("slow_query", query=label,
                             ms=round(dt_ms, 3), threshold_ms=threshold,
                             trace=ctx.trace_id)
+            # throttled: at most one auto-dump per
+            # mosaic.obs.dump.cooldown.ms across slow queries AND SLO
+            # breaches — a sustained slow workload is otherwise a dump
+            # storm.  The bundle embeds the profiler snapshot (host
+            # stacks + kernel ledger), so a slow query leaves a
+            # profile, not just a mark.
             try:
-                recorder.dump(reason="slow_query")
+                recorder.dump_throttled(reason="slow_query")
             except OSError:
                 pass
         return out

@@ -1,102 +1,115 @@
-"""Component-level timing of the PIP join on the real device."""
+"""Profile the streamed PIP join with the continuous-profiling plane.
+
+Thin CLI over ``mosaic_tpu.obs.profiler``: runs the flagship workload
+through :func:`make_streamed_pip_join` with the host sampler running
+and the kernel ledger collecting per-launch wall times, then prints
+the report and (optionally) writes collapsed-stack /
+speedscope-JSON / ``jax.profiler`` artifacts.  All measurement logic
+lives in the library — this file only parses flags and formats output.
+
+    python tools/profile_pip_join.py --n 1000000 --chunk 32768 \
+        --hz 200 --speedscope /tmp/join.speedscope.json
+
+Replaces the old hand-rolled per-stage timeit script; stage-level
+decomposition now comes for free from the flamegraph (host frames) and
+the ledger (device launches).
+"""
+import argparse
+import json
 import sys
 import time
 
-import numpy as np
-import jax
-import jax.numpy as jnp
+sys.path.insert(0, ".")
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def timeit(fn, *args, iters=5):
-    out = jax.block_until_ready(fn(*args))
-    ts = []
-    for _ in range(iters):
-        t0 = time.time()
-        jax.block_until_ready(fn(*args))
-        ts.append(time.time() - t0)
-    return float(np.median(ts)), out
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=1 << 20,
+                    help="points per batch (default 1M)")
+    ap.add_argument("--iters", type=int, default=3,
+                    help="timed warm iterations (default 3)")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="stream chunk rows (default: conf)")
+    ap.add_argument("--hz", type=float, default=None,
+                    help="host sampling rate (default: profiler's 97)")
+    ap.add_argument("--collapsed", metavar="PATH",
+                    help="write collapsed-stack text here")
+    ap.add_argument("--speedscope", metavar="PATH",
+                    help="write speedscope JSON here")
+    ap.add_argument("--device-trace", metavar="LOGDIR",
+                    help="also record a jax.profiler trace of the "
+                         "timed iterations into LOGDIR")
+    args = ap.parse_args(argv)
 
-
-def main():
+    import numpy as np
+    import jax
     from mosaic_tpu.bench.workloads import build_workload, nyc_points
-    from mosaic_tpu.parallel.pip_join import (build_pip_index, localize,
-                                              make_pip_join_fn, pip_assign,
-                                              _chip_pip, zone_histogram)
-    from mosaic_tpu.ops.lookup import lookup
+    from mosaic_tpu.obs import device_trace, start_profiler, \
+        stop_profiler
+    from mosaic_tpu.obs.profiler import ledger
+    from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                              make_streamed_pip_join)
 
-    platform = jax.devices()[0].platform
-    log("platform:", platform)
+    log("platform:", jax.devices()[0].platform)
     t0 = time.time()
     polys, grid, res = build_workload(n_side=16, grid_name="H3",
                                       zones="taxi")
-    # this tool profiles the SORTED path's stages (chip_a/core_cells/
-    # pip_assign are sorted-only); the dense path is profiled by bench.py
-    idx = build_pip_index(polys, res, grid, dense="never")
-    log(f"index build {time.time()-t0:.1f}s; chip_a shape "
-        f"{idx.chip_a.shape}, core {idx.core_cells.shape}, "
-        f"border {idx.border_cells.shape}, max_dup {idx.max_dup}")
-    edge_counts = np.asarray(idx.chip_mask).sum(1)
-    log("edges/chip: mean %.1f p50 %d p90 %d p99 %d max %d" % (
-        edge_counts.mean(), *np.percentile(edge_counts,
-                                           [50, 90, 99, 100]).astype(int)))
+    idx = build_pip_index(polys, res, grid)
+    log(f"index build {time.time() - t0:.1f}s "
+        f"({type(idx).__name__}, {len(polys)} zones)")
 
-    n = 1 << 22
-    pts64 = nyc_points(n)
-    pts = jnp.asarray(localize(idx, pts64))
+    run = make_streamed_pip_join(idx, grid, polys=polys,
+                                 chunk=args.chunk)
+    pts = nyc_points(args.n)
+    run(pts)                        # warm: compile the chunk kernel
+    ledger.reset()                  # timed iterations only
 
-    # 1. cell assignment alone
-    def cells_fn(p):
-        absolute = p + idx.origin.astype(p.dtype)
-        return grid.point_to_cell_jax_margin(absolute, idx.res)
-    f1 = jax.jit(cells_fn)
-    t, (cells, margin) = timeit(f1, pts)
-    log(f"cell assignment: {t*1e3:.1f} ms ({n/t/1e6:.1f}M pts/s)")
+    prof = start_profiler(args.hz)
+    times = []
+    try:
+        import contextlib
+        dt_ctx = device_trace(args.device_trace) \
+            if args.device_trace else contextlib.nullcontext()
+        with dt_ctx:
+            for _ in range(args.iters):
+                t0 = time.time()
+                run(pts)
+                times.append(time.time() - t0)
+    finally:
+        report = prof.report(max_stacks=50)
+        collapsed = prof.collapsed()
+        speedscope = prof.speedscope(name="pip_join streamed")
+        stop_profiler()
 
-    # 2. lookups alone
-    cells = jax.block_until_ready(cells)
-
-    def lookups_fn(c):
-        s1, f1_ = lookup(idx.core_cells, c)
-        s2, f2_ = lookup(idx.border_cells, c)
-        return s1, f1_, s2, f2_
-    t, _ = timeit(jax.jit(lookups_fn), cells)
-    log(f"two lookups: {t*1e3:.1f} ms")
-
-    # 3. single-dup chip pip (gather + parity + d2)
-    s0 = jnp.zeros(n, jnp.int32)
-
-    def one_dup(p, s):
-        return _chip_pip(p, idx, s)
-    t, _ = timeit(jax.jit(one_dup), pts, s0)
-    log(f"one _chip_pip dup (zero slots): {t*1e3:.1f} ms")
-
-    # random slots (realistic scattered gather)
-    sr = jnp.asarray(np.random.default_rng(0).integers(
-        0, idx.num_chips, n, dtype=np.int32))
-    t, _ = timeit(jax.jit(one_dup), pts, sr)
-    log(f"one _chip_pip dup (random slots): {t*1e3:.1f} ms")
-
-    # 4. full pip_assign
-    def assign_fn(p, c):
-        return pip_assign(p, c, idx)
-    t, _ = timeit(jax.jit(assign_fn), pts, cells)
-    log(f"pip_assign (all {idx.max_dup} dups): {t*1e3:.1f} ms")
-
-    # 5. full join
-    join = make_pip_join_fn(idx, grid)
-    t, _ = timeit(jax.jit(join), pts)
-    log(f"full join: {t*1e3:.1f} ms ({n/t/1e6:.2f}M pts/s)")
-
-    # 6. full join + histogram (bench step)
-    def step(p):
-        zone, unc = join(p)
-        return zone, zone_histogram(zone, len(polys)), jnp.sum(unc)
-    t, _ = timeit(jax.jit(step), pts)
-    log(f"bench step: {t*1e3:.1f} ms ({n/t/1e6:.2f}M pts/s)")
+    wall = float(np.median(times))
+    attributed = ledger.seconds("pip/streamed")
+    log(f"{args.n} pts x {args.iters}: median {wall * 1e3:.1f} ms "
+        f"({args.n / wall / 1e6:.2f}M pts/s); ledger attribution "
+        f"{attributed / max(sum(times), 1e-9):.3f}")
+    if args.collapsed:
+        with open(args.collapsed, "w") as f:
+            f.write(collapsed + "\n")
+        log("collapsed stacks ->", args.collapsed)
+    if args.speedscope:
+        with open(args.speedscope, "w") as f:
+            json.dump(speedscope, f)
+        log("speedscope profile ->", args.speedscope)
+    if args.device_trace:
+        log("device trace ->", args.device_trace)
+    print(json.dumps({
+        "n": args.n, "iters": args.iters,
+        "median_s": round(wall, 4),
+        "pts_per_s": round(args.n / wall),
+        "host": {"hz": report["hz"], "samples": report["samples"],
+                 "distinct_stacks": report["distinct_stacks"]},
+        "top_stacks": [{"frames": s["frames"][-3:], "count": s["count"]}
+                       for s in report["stacks"][:5]],
+        "ledger": ledger.report(),
+    }, indent=2))
 
 
 if __name__ == "__main__":
