@@ -518,6 +518,37 @@ def main():
     mismatch = int(np.sum(zs != truth))
     log(f"parity check: {mismatch}/{m} mismatches vs host float64 path")
 
+    # ------------------------------ per-principal accounting stage
+    # two tenants drive the warm streamed join through the accounting
+    # plane (obs.accounting); acceptance floor: >= 90% of the kernel
+    # ledger's device time from these passes lands on the right
+    # principal via the trace join.  The metered wall time joins the
+    # record so the console-smoke lane can A/B it against a
+    # MOSAIC_TPU_ACCOUNTING=0 run inside the perf-guard slip.
+    from mosaic_tpu.obs.accounting import accounted
+    from mosaic_tpu.obs.accounting import meter as _meter
+    from mosaic_tpu.obs.inflight import inflight as _inflight
+    _meter.reset()
+    led0 = _ledger.seconds("pip/streamed")
+    acct_times = []
+    tenants = ("tenant-a", "tenant-b")
+    for i, principal in enumerate(tenants):
+        with tracer.span("bench/flagship_accounted"):
+            with accounted(f"bench-join-{principal}",
+                           principal=principal):
+                t0 = time.time()
+                sjoin(host_batches[i % len(host_batches)])
+                acct_times.append(time.time() - t0)
+    led_delta = _ledger.seconds("pip/streamed") - led0
+    _rep = _meter.report()
+    acct_attr = sum(_rep.get(p, {}).get("device_s", 0.0)
+                    for p in tenants) / max(led_delta, 1e-9)
+    acct_ms = float(np.median(acct_times)) * 1e3
+    log(f"accounting: {acct_attr:.3f} of ledger device time attributed "
+        f"across {len(tenants)} tenants; metered streamed pass "
+        f"{acct_ms:.1f} ms (accounting "
+        f"{'on' if _inflight.enabled else 'off'})")
+
     # ------------------------------ SHARDED FLAGSHIP (multi-device)
     # the same workload through make_sharded_streamed_pip_join: the
     # double-buffered executor + bucketed kernel cache + skew-aware
@@ -688,6 +719,18 @@ def main():
         "ledger_dropped": _led_rep["dropped"],
         "kernels": [{k: v for k, v in e.items() if k != "key"}
                     for e in _led_rep["kernels"][:12]],
+    }
+
+    # query accounting plane: the two-tenant metered passes + the
+    # per-principal attribution floor asserted by console-smoke
+    record["accounting"] = {
+        "enabled": _inflight.enabled,
+        "attribution_frac": round(acct_attr, 4),
+        "accounted_pass_ms": round(acct_ms, 1),
+        "principals": {p: {"device_s": round(
+            _rep.get(p, {}).get("device_s", 0.0), 4),
+            "queries": _rep.get(p, {}).get("queries", 0)}
+            for p in tenants},
     }
 
     if smoke:

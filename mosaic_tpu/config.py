@@ -89,6 +89,16 @@ MOSAIC_PLANNER_FORCE_PREFIX = "mosaic.planner.force."
 # brute-right-max row threshold; models/knn.py).
 MOSAIC_STREAM_CHUNK_ROWS = "mosaic.stream.chunk.rows"
 MOSAIC_KNN_STRATEGY = "mosaic.knn.strategy"
+# Query accounting plane (obs/inflight.py + obs/accounting.py): the
+# principal every query from this config is attributed to (session
+# attribute `SQLSession.principal` overrides it; "" -> "anonymous"),
+# a per-query cooperative deadline in milliseconds (0 disables; an
+# expired deadline raises QueryCancelled at the next operator /
+# chunk boundary), and the JSONL audit-spool path ("" keeps the
+# audit log in-memory only).
+MOSAIC_PRINCIPAL = "mosaic.principal"
+MOSAIC_QUERY_DEADLINE_MS = "mosaic.query.deadline.ms"
+MOSAIC_AUDIT_PATH = "mosaic.audit.path"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -173,6 +183,15 @@ class MosaicConfig:
     stream_chunk_rows: int = 262_144
     # "auto" | "brute" | "ring" | positive-int brute-right-max.
     knn_strategy: str = "auto"
+    # Principal queries under this config are metered as ("" falls
+    # back to "anonymous"; SQLSession.principal overrides per session).
+    principal: str = ""
+    # Cooperative per-query deadline (ms): a query past it raises
+    # QueryCancelled at its next checkpoint.  0 = no deadline.
+    query_deadline_ms: float = 0.0
+    # JSONL audit-spool path for query completion records; "" keeps
+    # the audit log in-memory only (bounded ring).
+    audit_path: str = ""
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -295,6 +314,9 @@ _CONF_FIELDS = {
     MOSAIC_PLANNER_STATS_PATH: ("planner_stats_path", _as_str),
     MOSAIC_STREAM_CHUNK_ROWS: ("stream_chunk_rows", _as_blocksize),
     MOSAIC_KNN_STRATEGY: ("knn_strategy", _as_knn_strategy),
+    MOSAIC_PRINCIPAL: ("principal", _as_str),
+    MOSAIC_QUERY_DEADLINE_MS: ("query_deadline_ms", _as_millis),
+    MOSAIC_AUDIT_PATH: ("audit_path", _as_str),
 }
 
 

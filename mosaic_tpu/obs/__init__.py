@@ -39,6 +39,16 @@ shim).  Twelve parts:
   ``mosaic.obs.profile.hz`` / ``MOSAIC_TPU_PROFILE_HZ``), the
   per-kernel device-cost ledger, and triggered capture into flight
   bundles (plus speedscope export and the ``/profile`` flamegraph).
+* ``obs.inflight`` — the in-flight query registry: per-query
+  :class:`QueryTicket` with live cost counters, cooperative
+  cancellation (``inflight.cancel(id)``) and ``mosaic.query.
+  deadline.ms`` deadlines raising :class:`QueryCancelled` at
+  operator / chunk boundaries.
+* ``obs.accounting`` — the metering plane over it: per-principal
+  cost meter (``principal/*`` series + labeled OpenMetrics families
+  + auto-registered per-principal SLOs), the bounded query audit log
+  (ring + ``mosaic.audit.path`` JSONL spool), and the
+  ``accounted()`` context manager for non-SQL workloads.
 
 The tracer and registry are disabled by default and cost one attribute
 check per instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
@@ -53,12 +63,16 @@ from __future__ import annotations
 
 import os as _os
 
+from .accounting import (AuditLog, PrincipalMeter, accounted, audit,
+                         complete, meter)
 from .chrometrace import chrome_trace_events, export_chrome_trace
 from .context import (TraceContext, current_trace, current_trace_id,
                       install_thread_propagation, new_trace, root_trace,
                       traced)
 from .dashboard import serve_dashboard
 from .devicemon import DeviceMonitor, devicemon, mesh_device_keys
+from .inflight import (InflightRegistry, QueryCancelled, QueryTicket,
+                       checkpoint, inflight)
 from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
                      last_watermarks, record_cost_analysis,
                      sample_memory)
@@ -68,7 +82,8 @@ from .profiler import (HostProfiler, KernelLedger, capture_snapshot,
                        configure_profiler, ledger, maybe_device_capture,
                        profiler, start_profiler, stop_profiler)
 from .recorder import FlightRecorder, install_excepthook, recorder
-from .slo import SLObjective, SLOMonitor, default_objectives, monitor
+from .slo import (SLObjective, SLOMonitor, default_objectives, monitor,
+                  principal_objectives)
 from .timeseries import (Sampler, TimeSeriesStore, configure_sampler,
                          sampler, start_sampler, stop_sampler,
                          timeseries)
@@ -89,11 +104,16 @@ __all__ = [
     "TimeSeriesStore", "timeseries", "Sampler", "start_sampler",
     "stop_sampler", "sampler", "configure_sampler",
     "SLObjective", "SLOMonitor", "monitor", "default_objectives",
+    "principal_objectives",
     "DeviceMonitor", "devicemon", "mesh_device_keys",
     "serve_dashboard",
     "HostProfiler", "KernelLedger", "ledger", "profiler",
     "start_profiler", "stop_profiler", "configure_profiler",
     "capture_snapshot", "maybe_device_capture",
+    "InflightRegistry", "QueryCancelled", "QueryTicket", "inflight",
+    "checkpoint",
+    "AuditLog", "PrincipalMeter", "accounted", "audit", "complete",
+    "meter",
     "configure",
 ]
 

@@ -1,0 +1,269 @@
+"""Per-principal metering and the bounded query audit log.
+
+Reference counterpart: the Spark history server's per-user job
+accounting, minus the 40 GB of event logs.  LocationSpark (arxiv
+1907.03736) schedules queries over exactly this kind of monitored
+per-query cost; SOLAR (arxiv 2504.01292) shows the same records
+doubling as planner training data — our cost-based planner already
+learns from ``observe_op``, and the audit log gives it durable
+per-query ground truth to learn from next.
+
+Three pieces, all fed by :mod:`~.inflight` tickets at completion:
+
+* :class:`PrincipalMeter` — folds each completed ticket's cost vector
+  (wall ms, device seconds joined from the :class:`~.profiler.
+  KernelLedger` via trace attribution, rows in/out, H2D bytes, compile
+  count) into per-principal totals, and mirrors them into
+  ``principal/<field>/<name>`` metrics so the sampler turns them into
+  time-series and OpenMetrics exports them as labeled
+  ``mosaic_principal_*{principal="..."}`` families.
+* :class:`AuditLog` — bounded in-memory ring of completion records
+  (principal, cost vector, planner strategy decisions, outcome
+  ok/error/cancelled/deadline), optionally spooled as JSONL when
+  ``mosaic.audit.path`` is set (path re-read per write, so ``SET``
+  takes effect immediately).
+* per-principal SLOs — the first completion for a new principal
+  registers a loose ``gauge_max`` (per-query latency ceiling) and
+  ``counter_rate`` (query-rate ceiling) pair with the global monitor;
+  tenants get burn-rate alerting without any per-tenant config.
+
+:func:`accounted` is the non-SQL entry point: a context manager that
+opens a trace + ticket around arbitrary work (the benchmark's
+two-principal attribution stage uses it around raw streamed joins).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from .context import new_trace
+from .inflight import QueryTicket, inflight
+from .metrics import metrics
+from .recorder import recorder
+from .slo import principal_objectives
+from .timeseries import timeseries
+
+__all__ = ["PrincipalMeter", "AuditLog", "meter", "audit",
+           "complete", "accounted", "principal_objectives"]
+
+#: cost-vector fields the meter accumulates per principal
+_METER_FIELDS = ("queries", "wall_ms", "device_s", "rows_in",
+                 "rows_out", "h2d_bytes", "compiles")
+
+
+class PrincipalMeter:
+    """Per-principal cost accumulator; cheap enough to stay always on
+    (one dict update per completed query, nothing per operator)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._totals: Dict[str, Dict[str, float]] = {}
+        self._outcomes: Dict[str, Dict[str, int]] = {}
+
+    def charge(self, principal: str, cost: Dict[str, float],
+               outcome: str = "ok") -> None:
+        """Fold one completed query's cost vector into the principal's
+        totals and mirror it into ``principal/*`` metrics."""
+        first = False
+        with self._lock:
+            tot = self._totals.get(principal)
+            if tot is None:
+                first = True
+                tot = self._totals[principal] = \
+                    {f: 0.0 for f in _METER_FIELDS}
+                self._outcomes[principal] = collections.defaultdict(int)
+            tot["queries"] += 1
+            for f in _METER_FIELDS[1:]:
+                tot[f] += float(cost.get(f, 0.0))
+            self._outcomes[principal][outcome] += 1
+        if metrics.enabled:
+            metrics.count(f"principal/queries/{principal}")
+            metrics.count(f"principal/wall_ms/{principal}",
+                          float(cost.get("wall_ms", 0.0)))
+            metrics.count(f"principal/device_s/{principal}",
+                          float(cost.get("device_s", 0.0)))
+            metrics.count(f"principal/rows_out/{principal}",
+                          float(cost.get("rows_out", 0.0)))
+            metrics.count(f"principal/h2d_bytes/{principal}",
+                          float(cost.get("h2d_bytes", 0.0)))
+            metrics.count(f"principal/compiles/{principal}",
+                          float(cost.get("compiles", 0.0)))
+            if outcome != "ok":
+                metrics.count(f"principal/failures/{principal}")
+        # a per-query latency point (the gauge_max SLO's series); the
+        # sampler mirrors the counters above into same-named series
+        timeseries.record(f"principal/query_ms/{principal}",
+                          float(cost.get("wall_ms", 0.0)))
+        if first:
+            from .slo import monitor
+            for obj in principal_objectives(principal):
+                monitor.add_objective(obj)
+
+    # -- reads
+    def principals(self) -> List[str]:
+        with self._lock:
+            return sorted(self._totals)
+
+    def report(self) -> Dict[str, Dict[str, object]]:
+        """{principal: {totals..., outcomes: {...}}} for
+        ``/api/principals`` and the bench attribution check."""
+        with self._lock:
+            return {
+                p: dict({f: (int(v) if f in ("queries", "rows_in",
+                                             "rows_out", "h2d_bytes",
+                                             "compiles")
+                             else round(v, 6))
+                         for f, v in tot.items()},
+                        outcomes=dict(self._outcomes[p]))
+                for p, tot in self._totals.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._totals.clear()
+            self._outcomes.clear()
+
+
+class AuditLog:
+    """Bounded ring of query completion records + optional JSONL spool.
+
+    One record per completed query — also for cancelled / deadline /
+    errored ones, whose cost vector is the partial cost at the point
+    the query stopped.  The ring keeps the last ``capacity`` records
+    in memory for the console; the spool (``mosaic.audit.path``)
+    appends every record as one JSON line for offline retention."""
+
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._written = 0
+        self._spool_errors = 0
+
+    def append(self, record: Dict[str, object]) -> None:
+        with self._lock:
+            self._ring.append(record)
+            self._written += 1
+        recorder.record("audit", **record)
+        path = self._spool_path()
+        if path:
+            try:
+                with open(path, "a", encoding="utf-8") as fh:
+                    fh.write(json.dumps(record, default=str,
+                                        sort_keys=True) + "\n")
+            except OSError:
+                # retention is best-effort; never fail the query over
+                # a full disk — surface it as a counter instead
+                self._spool_errors += 1
+                if metrics.enabled:
+                    metrics.count("audit/spool_errors")
+
+    @staticmethod
+    def _spool_path() -> str:
+        from .. import config as _config
+        return getattr(_config.default_config(), "audit_path", "") or ""
+
+    # -- reads
+    def records(self, principal: Optional[str] = None,
+                outcome: Optional[str] = None,
+                limit: int = 0) -> List[Dict[str, object]]:
+        """Newest-last view of the ring, optionally filtered."""
+        with self._lock:
+            recs = list(self._ring)
+        if principal is not None:
+            recs = [r for r in recs if r.get("principal") == principal]
+        if outcome is not None:
+            recs = [r for r in recs if r.get("outcome") == outcome]
+        return recs[-limit:] if limit else recs
+
+    def written(self) -> int:
+        with self._lock:
+            return self._written
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._written = 0
+            self._spool_errors = 0
+
+
+#: process-global meter + audit log (the completion path below feeds
+#: both; the dashboard and OpenMetrics read them)
+meter = PrincipalMeter()
+audit = AuditLog()
+
+
+def complete(ticket: Optional[QueryTicket], outcome: str = "ok",
+             error: Optional[BaseException] = None,
+             wall_ms: Optional[float] = None) -> Optional[Dict[str, object]]:
+    """Close the books on one query: build the final cost vector from
+    the ticket, write the audit record, charge the meter, and remove
+    the ticket from the in-flight registry.  Safe no-op for a None
+    ticket (accounting disabled).  Returns the audit record."""
+    if ticket is None:
+        return None
+    if wall_ms is None:
+        wall_ms = ticket.wall_ms
+    compiles = int(max(0.0, metrics.counter_value("jax/recompiles")
+                       - ticket.compiles0))
+    cost = {
+        "wall_ms": round(float(wall_ms), 3),
+        "device_s": round(ticket.device_s, 6),
+        "rows_in": int(ticket.rows_in),
+        "rows_out": int(ticket.rows),
+        "h2d_bytes": int(ticket.h2d_bytes),
+        "compiles": compiles,
+    }
+    record: Dict[str, object] = {
+        "query_id": ticket.query_id,
+        "principal": ticket.principal,
+        "sql": ticket.sql,
+        "trace": ticket.trace_id,
+        "start_ts": round(ticket.start_ts, 3),
+        "end_ts": round(time.time(), 3),
+        "outcome": outcome,
+        "operator": ticket.operator,
+        "strategies": dict(ticket.strategies),
+        "cost": cost,
+    }
+    if error is not None:
+        record["error"] = f"{type(error).__name__}: {error}"
+    inflight.finish(ticket, status=outcome)
+    audit.append(record)
+    meter.charge(ticket.principal,
+                 {"wall_ms": cost["wall_ms"],
+                  "device_s": cost["device_s"],
+                  "rows_in": float(cost["rows_in"]),
+                  "rows_out": float(cost["rows_out"]),
+                  "h2d_bytes": float(cost["h2d_bytes"]),
+                  "compiles": compiles},
+                 outcome=outcome)
+    return record
+
+
+@contextlib.contextmanager
+def accounted(name: str, principal: str = "anonymous",
+              deadline_ms: float = 0.0) -> Iterator[Optional[QueryTicket]]:
+    """Meter an arbitrary block of work as one query: opens a trace
+    (so ledger/pipeline charges attribute here), registers a ticket,
+    and completes it with the right outcome on exit.  The SQL engine
+    has its own inlined version of this lifecycle; use this for
+    non-SQL workloads (the benchmark's two-principal stage does)."""
+    from .inflight import QueryCancelled
+    with new_trace(name):
+        ticket = inflight.register(name, principal=principal,
+                                   deadline_ms=deadline_ms)
+        try:
+            yield ticket
+        except QueryCancelled as exc:
+            complete(ticket, outcome=exc.outcome, error=exc)
+            raise
+        except BaseException as exc:
+            complete(ticket, outcome="error", error=exc)
+            raise
+        else:
+            complete(ticket, outcome="ok")

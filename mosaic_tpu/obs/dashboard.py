@@ -20,6 +20,17 @@ Routes:
 * ``/api/profile``      — profiler snapshot: host stacks (``?trace=``
   filters to one trace context), kernel ledger, collapsed text
 * ``/profile``          — the flamegraph view over ``/api/profile``
+* ``/api/queries``      — live query console: in-flight tickets
+  (``obs.inflight``) + recent audit completions (``?limit=``)
+* ``/api/principals``   — per-principal meter totals (``obs.accounting``)
+* ``POST /api/queries/<id>/cancel`` — request cooperative cancellation
+  of an in-flight query (POST-only: GET answers 405; an unknown id
+  answers a JSON 404)
+
+API hygiene: every JSON response carries ``Cache-Control: no-store``
+(live state must never be served from a browser cache), and unknown
+``/api/*`` paths answer a JSON 404 body — a poller never gets an HTML
+error page where it expects JSON.
 
 ``serve_dashboard(port=0)`` returns the same stoppable
 :class:`~.openmetrics.ServerHandle` as ``serve_metrics`` — close it
@@ -30,6 +41,7 @@ from __future__ import annotations
 
 import http.server
 import json
+import re
 import time
 import urllib.parse
 from typing import Dict, Optional
@@ -46,6 +58,8 @@ __all__ = ["serve_dashboard"]
 _MAX_POINTS = 500          # raw points per /api/timeseries response
 _MAX_TRACES = 20
 _MAX_EVENTS = 50
+_MAX_AUDIT = 100           # recent completions per /api/queries
+_CANCEL_RE = re.compile(r"^/api/queries/([^/]+)/cancel$")
 
 
 def _summary(t0: float) -> Dict[str, object]:
@@ -126,6 +140,26 @@ def _devices_payload() -> Dict[str, object]:
     return devicemon.report()
 
 
+def _queries_payload(qs: Dict[str, list]) -> Dict[str, object]:
+    from .accounting import audit
+    from .inflight import inflight
+    try:
+        limit = int((qs.get("limit") or ["20"])[0])
+    except ValueError:
+        limit = 20
+    limit = max(1, min(limit, _MAX_AUDIT))
+    return {
+        "inflight": inflight.list_active(),
+        "recent": audit.records(limit=limit),
+        "audited": audit.written(),
+    }
+
+
+def _principals_payload() -> Dict[str, object]:
+    from .accounting import meter
+    return {"principals": meter.report()}
+
+
 def _profile_payload(qs: Dict[str, list]) -> Dict[str, object]:
     from .profiler import ledger, profiler
     trace = (qs.get("trace") or [None])[0] or None
@@ -168,6 +202,9 @@ _PAGE = """<!doctype html>
  <span id="stats"></span></h2>
 <svg id="chart" width="640" height="120"></svg>
 <h2>Devices</h2><table id="devices"></table>
+<h2>Queries in flight</h2><table id="queries"></table>
+<h2>Recent completions</h2><table id="recent"></table>
+<h2>Principals</h2><table id="principals"></table>
 <script>
 const $=id=>document.getElementById(id);
 async function j(u){const r=await fetch(u);return r.json()}
@@ -208,7 +245,38 @@ async function tick(){
    v.busy_s.toFixed(3)+"</td><td>"+(v.util||0).toFixed(2)+
    "</td><td>"+v.rows+"</td><td>"+(v.peak_bytes||"-")+
    "</td></tr>").join("");
+ const esc=t=>String(t).replace(/&/g,"&amp;").replace(/</g,"&lt;");
+ const q=await j("/api/queries");
+ $("queries").innerHTML="<tr><th>id</th><th>principal</th>"+
+  "<th>sql</th><th>operator</th><th>wall_ms</th><th>rows</th>"+
+  "<th></th></tr>"+(q.inflight.length?q.inflight.map(x=>"<tr><td>"+
+   esc(x.query_id)+"</td><td>"+esc(x.principal)+"</td><td><code>"+
+   esc(x.sql)+"</code></td><td>"+esc(x.operator)+"</td><td>"+
+   x.cost.wall_ms.toFixed(0)+"</td><td>"+x.cost.rows+"</td><td>"+
+   (x.cancel_requested?"cancelling…":'<button onclick="cancelQ(\\''+
+    x.query_id+'\\')">cancel</button>')+"</td></tr>").join(""):
+   '<tr><td colspan="7" class="ok">idle</td></tr>');
+ $("recent").innerHTML="<tr><th>id</th><th>principal</th>"+
+  "<th>outcome</th><th>wall_ms</th><th>device_s</th><th>rows</th>"+
+  "</tr>"+q.recent.slice().reverse().map(r=>"<tr><td>"+
+   esc(r.query_id)+"</td><td>"+esc(r.principal)+"</td><td"+
+   (r.outcome==="ok"?">":' class="bad">')+esc(r.outcome)+
+   "</td><td>"+r.cost.wall_ms.toFixed(0)+"</td><td>"+
+   r.cost.device_s.toFixed(4)+"</td><td>"+r.cost.rows_out+
+   "</td></tr>").join("");
+ const pr=await j("/api/principals");
+ $("principals").innerHTML="<tr><th>principal</th><th>queries</th>"+
+  "<th>wall_ms</th><th>device_s</th><th>rows_out</th>"+
+  "<th>h2d_bytes</th><th>compiles</th></tr>"+
+  Object.entries(pr.principals).map(([p,v])=>"<tr><td>"+esc(p)+
+   "</td><td>"+v.queries+"</td><td>"+v.wall_ms.toFixed(0)+
+   "</td><td>"+v.device_s.toFixed(4)+"</td><td>"+v.rows_out+
+   "</td><td>"+v.h2d_bytes+"</td><td>"+v.compiles+
+   "</td></tr>").join("");
 }
+async function cancelQ(id){
+ await fetch("/api/queries/"+encodeURIComponent(id)+"/cancel",
+  {method:"POST"});tick()}
 tick();setInterval(tick,2000);
 </script></body></html>
 """
@@ -294,16 +362,27 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
     t0 = time.time()
 
     class _Handler(http.server.BaseHTTPRequestHandler):
-        def _send(self, body: bytes, ctype: str) -> None:
-            self.send_response(200)
+        def _send(self, body: bytes, ctype: str, code: int = 200,
+                  extra: Optional[Dict[str, str]] = None) -> None:
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _json(self, payload) -> None:
+        def _json(self, payload, code: int = 200,
+                  extra: Optional[Dict[str, str]] = None) -> None:
+            # no-store: these are live snapshots; a cached /api/queries
+            # would show phantom in-flight queries
+            hdrs = {"Cache-Control": "no-store"}
+            hdrs.update(extra or {})
             self._send(json.dumps(payload, default=str).encode(),
-                       "application/json")
+                       "application/json", code=code, extra=hdrs)
+
+        def _api_404(self, path: str) -> None:
+            self._json({"error": "not found", "path": path}, code=404)
 
         def do_GET(self):
             path, _, query = self.path.partition("?")
@@ -330,13 +409,42 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     self._json(_devices_payload())
                 elif path == "/api/profile":
                     self._json(_profile_payload(qs))
+                elif path == "/api/queries":
+                    self._json(_queries_payload(qs))
+                elif path == "/api/principals":
+                    self._json(_principals_payload())
+                elif _CANCEL_RE.match(path):
+                    # cancel mutates: POST-only, so a prefetching
+                    # browser/crawler can never kill a query
+                    self._json({"error": "method not allowed",
+                                "path": path}, code=405,
+                               extra={"Allow": "POST"})
                 elif path == "/profile":
                     self._send(_PROFILE_PAGE.encode(),
                                "text/html; charset=utf-8")
+                elif path.startswith("/api/"):
+                    self._api_404(path)
                 else:
                     self.send_error(404)
             except BrokenPipeError:
                 pass              # poller navigated away mid-response
+
+        def do_POST(self):
+            path, _, _ = self.path.partition("?")
+            try:
+                m = _CANCEL_RE.match(path)
+                if m:
+                    from .inflight import inflight
+                    qid = m.group(1)
+                    ok = inflight.cancel(qid)
+                    self._json({"query_id": qid, "cancelled": ok},
+                               code=200 if ok else 404)
+                elif path.startswith("/api/"):
+                    self._api_404(path)
+                else:
+                    self.send_error(404)
+            except BrokenPipeError:
+                pass
 
         def log_message(self, *args):   # polls must not spam stderr
             pass

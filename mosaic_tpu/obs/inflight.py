@@ -1,0 +1,309 @@
+"""In-flight query registry: tickets, cooperative cancellation, deadlines.
+
+Reference counterpart: the Spark UI's "running queries" pane plus
+``spark.sparkContext.cancelJobGroup`` — the pair that makes a
+multi-tenant service operable.  Standalone, ``SQLSession.sql()`` was a
+fire-and-forget call: no identity, no deadline, no way to stop a
+runaway query.  This module is the registry half of ROADMAP item 3's
+metering arc (the enforcement half — quotas, admission control —
+builds on it later).
+
+Every query registers a :class:`QueryTicket` (query id, principal,
+SQL text, start time, current operator, live row/byte counters) in
+the process-global :class:`InflightRegistry` for its lifetime.
+Cancellation is **cooperative**: :func:`cancel` (or an expired
+``mosaic.query.deadline.ms`` deadline) only flags the ticket; the
+running query observes the flag at its next :func:`checkpoint` — one
+is placed at every engine operator boundary and between
+``perf.pipeline.stream`` chunks — and raises :class:`QueryCancelled`
+there.  Device work is never abandoned mid-launch: the streamed
+executor drains its worker before the error propagates, so a
+cancelled streamed query stops within one chunk boundary with no
+leaked threads or device buffers.
+
+Attribution rides the trace context (``obs.context``): the ticket is
+keyed by its query's trace id, worker threads inherit the trace, so
+kernel-ledger launch times and pipeline H2D bytes observed anywhere
+under the query charge the right ticket (the per-principal meter in
+``obs.accounting`` folds completed tickets).
+
+Quiescent cost: one empty-dict check per probe when no query is
+registered anywhere in the process; env ``MOSAIC_TPU_ACCOUNTING=0``
+disables registration entirely (the bench overhead A/B's off arm).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .context import current_trace_id
+
+__all__ = ["QueryCancelled", "QueryTicket", "InflightRegistry",
+           "inflight", "checkpoint", "charge_device_seconds",
+           "charge_h2d_bytes", "note_rows", "note_rows_in",
+           "note_strategies"]
+
+_qids = itertools.count(1)
+
+
+class QueryCancelled(RuntimeError):
+    """Raised inside a query at the first checkpoint after a cancel
+    or deadline expiry.  Deliberately NOT a :class:`~..sql.engine.
+    SQLError`: cancellation is an operator/deadline action, not a
+    client mistake — the engine records it with its own outcome
+    (``cancelled`` / ``deadline``) and bumps neither ``sql/errors``
+    nor the client-error path."""
+
+    def __init__(self, query_id: str, reason: str = "cancel"):
+        self.query_id = query_id
+        #: ``"cancel"`` (explicit cancel()) or ``"deadline"``
+        self.reason = reason
+        outcome = "deadline" if reason == "deadline" else "cancelled"
+        super().__init__(f"query {query_id} {outcome} "
+                         f"({'deadline exceeded' if reason == 'deadline' else 'cancel requested'})")
+
+    @property
+    def outcome(self) -> str:
+        return "deadline" if self.reason == "deadline" else "cancelled"
+
+
+class QueryTicket:
+    """One registered query: identity + live progress counters.
+
+    Mutated from multiple threads (the query's own, pipeline workers,
+    the dashboard's cancel handler); every mutation is a single
+    GIL-atomic attribute write or an int/float augmented assignment
+    under the registry's read patterns — small races only smear live
+    counters, never correctness."""
+
+    def __init__(self, query_id: str, principal: str, sql: str,
+                 trace_id: Optional[str], deadline_ms: float = 0.0):
+        self.query_id = query_id
+        self.principal = principal
+        self.sql = sql
+        self.trace_id = trace_id
+        self.start_ts = time.time()
+        self._t0 = time.perf_counter()
+        #: absolute perf_counter deadline, or None
+        self.deadline = (self._t0 + deadline_ms / 1e3
+                         if deadline_ms and deadline_ms > 0 else None)
+        self.operator = "-"          # current engine operator
+        self.rows = 0                # rows out of the last stage
+        self.rows_in = 0             # rows out of the scan/join stage
+        self.compiles0 = 0.0         # jax/recompiles at registration
+        self.h2d_bytes = 0           # pipeline staging charged here
+        self.device_s = 0.0          # kernel-ledger launch seconds
+        self.strategies: Dict[str, str] = {}   # planner picks per op
+        self.status = "running"
+        self._cancel_reason: Optional[str] = None
+
+    # -- cooperative cancellation
+    def request_cancel(self, reason: str = "cancel") -> None:
+        if self._cancel_reason is None:
+            self._cancel_reason = reason
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_reason is not None
+
+    def check(self) -> None:
+        """Raise :class:`QueryCancelled` if flagged or past deadline."""
+        if self._cancel_reason is not None:
+            raise QueryCancelled(self.query_id, self._cancel_reason)
+        if self.deadline is not None and \
+                time.perf_counter() > self.deadline:
+            self._cancel_reason = "deadline"
+            raise QueryCancelled(self.query_id, "deadline")
+
+    # -- reads
+    @property
+    def wall_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e3
+
+    def cost(self) -> Dict[str, object]:
+        """The live cost vector (partial until the query completes)."""
+        return {
+            "wall_ms": round(self.wall_ms, 3),
+            "device_s": round(self.device_s, 6),
+            "rows": int(self.rows),
+            "h2d_bytes": int(self.h2d_bytes),
+        }
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-ready state for ``/api/queries``."""
+        return {
+            "query_id": self.query_id,
+            "principal": self.principal,
+            "sql": self.sql,
+            "trace": self.trace_id,
+            "start_ts": round(self.start_ts, 3),
+            "status": self.status,
+            "operator": self.operator,
+            "cancel_requested": self.cancel_requested,
+            "deadline_ms": round((self.deadline - self._t0) * 1e3, 1)
+            if self.deadline is not None else 0.0,
+            "cost": self.cost(),
+        }
+
+
+class InflightRegistry:
+    """Process-global map of running queries, keyed by query id AND by
+    trace id (the checkpoint/attribution lookup key)."""
+
+    def __init__(self):
+        env = os.environ.get("MOSAIC_TPU_ACCOUNTING", "").strip().lower()
+        #: registration switch (``MOSAIC_TPU_ACCOUNTING=0`` = off —
+        #: the bench overhead A/B's off arm); checks stay one empty-
+        #: dict probe either way
+        self.enabled = env not in ("0", "off", "false", "no")
+        self._lock = threading.Lock()
+        self._active: Dict[str, QueryTicket] = {}       # qid -> ticket
+        self._by_trace: Dict[str, QueryTicket] = {}     # trace -> ticket
+
+    # -- lifecycle
+    def register(self, sql: str, principal: str = "anonymous",
+                 deadline_ms: float = 0.0,
+                 trace_id: Optional[str] = None) -> Optional[QueryTicket]:
+        """Open a ticket (None when accounting is disabled).
+        ``trace_id`` defaults to the active trace context's id."""
+        if not self.enabled:
+            return None
+        if trace_id is None:
+            trace_id = current_trace_id()
+        t = QueryTicket(f"q{os.getpid()}-{next(_qids)}", principal,
+                        sql, trace_id, deadline_ms)
+        from .metrics import metrics
+        t.compiles0 = metrics.counter_value("jax/recompiles")
+        with self._lock:
+            self._active[t.query_id] = t
+            if trace_id is not None:
+                self._by_trace[trace_id] = t
+        if metrics.enabled:
+            metrics.count("inflight/registered")
+            metrics.gauge("inflight/active", float(len(self._active)))
+        return t
+
+    def finish(self, ticket: Optional[QueryTicket],
+               status: str = "ok") -> None:
+        """Close a ticket (idempotent; None passes through)."""
+        if ticket is None:
+            return
+        ticket.status = status
+        with self._lock:
+            self._active.pop(ticket.query_id, None)
+            if ticket.trace_id is not None and \
+                    self._by_trace.get(ticket.trace_id) is ticket:
+                self._by_trace.pop(ticket.trace_id, None)
+        from .metrics import metrics
+        if metrics.enabled:
+            metrics.gauge("inflight/active", float(len(self._active)))
+
+    # -- control
+    def cancel(self, query_id: str, reason: str = "cancel") -> bool:
+        """Flag a running query for cancellation; True if it was
+        found in flight.  The query raises at its next checkpoint."""
+        with self._lock:
+            t = self._active.get(query_id)
+        if t is None:
+            return False
+        t.request_cancel(reason)
+        from .metrics import metrics
+        if metrics.enabled:
+            metrics.count("inflight/cancel_requests")
+        from .recorder import recorder
+        recorder.record("query_cancel_requested", query_id=query_id,
+                        principal=t.principal, reason=reason)
+        return True
+
+    # -- reads
+    def get(self, query_id: str) -> Optional[QueryTicket]:
+        with self._lock:
+            return self._active.get(query_id)
+
+    def ticket_for_trace(self, trace_id: Optional[str]
+                         ) -> Optional[QueryTicket]:
+        if trace_id is None:
+            return None
+        return self._by_trace.get(trace_id)
+
+    def list_active(self) -> List[Dict[str, object]]:
+        with self._lock:
+            tickets = list(self._active.values())
+        return [t.snapshot() for t in
+                sorted(tickets, key=lambda t: t.start_ts)]
+
+    def __len__(self) -> int:
+        return len(self._active)
+
+
+#: the process-global registry every SQLSession.sql() call feeds
+inflight = InflightRegistry()
+
+
+# ------------------------------------------------------------- probes
+#
+# Module-level helpers with the one-empty-dict-check quiescent cost.
+# They key off the ACTIVE TRACE: worker threads inherit the spawning
+# query's trace (obs.context.install_thread_propagation), so charges
+# from pipeline workers land on the right ticket.
+
+def _active_ticket() -> Optional[QueryTicket]:
+    if not inflight._by_trace:          # quiescent fast path
+        return None
+    return inflight._by_trace.get(current_trace_id())
+
+
+def checkpoint(operator: Optional[str] = None) -> None:
+    """Cooperative cancellation probe: update the active ticket's
+    current operator and raise :class:`QueryCancelled` if it was
+    cancelled or blew its deadline.  No-op (one dict check) outside
+    any registered query."""
+    t = _active_ticket()
+    if t is None:
+        return
+    if operator is not None:
+        t.operator = operator
+    t.check()
+
+
+def charge_device_seconds(seconds: float) -> None:
+    """Charge kernel-launch wall time to the active ticket (called
+    from :meth:`~.profiler.KernelLedger.observe` — the trace join
+    that gives the per-principal meter its device_s column)."""
+    t = _active_ticket()
+    if t is not None:
+        t.device_s += float(seconds)
+
+
+def charge_h2d_bytes(n: int) -> None:
+    """Charge host->device staging bytes to the active ticket."""
+    t = _active_ticket()
+    if t is not None:
+        t.h2d_bytes += int(n)
+
+
+def note_rows(rows: int) -> None:
+    """Record the latest stage's output rows on the active ticket."""
+    t = _active_ticket()
+    if t is not None:
+        t.rows = int(rows)
+
+
+def note_rows_in(rows: int) -> None:
+    """Record the source stage's (scan/join) output rows — the audit
+    record's rows_in column."""
+    t = _active_ticket()
+    if t is not None:
+        t.rows_in = int(rows)
+
+
+def note_strategies(strategies: Dict[str, str]) -> None:
+    """Attach the planner's per-operator strategy picks to the active
+    ticket (they land in the audit completion record)."""
+    t = _active_ticket()
+    if t is not None:
+        t.strategies.update(strategies)

@@ -14,6 +14,15 @@ format so any Prometheus/OpenMetrics scraper ingests it unchanged:
 Metric names are sanitized to ``[a-zA-Z0-9_]`` under a ``mosaic_``
 namespace prefix (``sql/scan_s`` -> ``mosaic_sql_scan_s``).
 
+Per-principal accounting series (``principal/<field>/<principal>``
+from ``obs.accounting``) render as ONE labeled family per field —
+``mosaic_principal_<field>_total{principal="..."}`` — instead of one
+sanitized name per tenant, so a scraper can aggregate/alert across
+principals with plain label matchers.  Principal names are free-form
+user input, so label values (and HELP text) are escaped per the
+Prometheus text format: ``\\`` -> ``\\\\``, ``"`` -> ``\\"``, newline
+-> ``\\n``.
+
 :func:`serve_metrics` starts a stdlib-only ``ThreadingHTTPServer`` on a
 daemon thread serving ``GET /metrics`` — no third-party client library,
 matching the package's no-new-deps rule.
@@ -52,20 +61,70 @@ def _fmt(v: float) -> str:
     return f"{float(v):.10g}"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (backslash first —
+    it is the escape character itself)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping: only ``\\`` and newline are special there
+    (quotes are literal in HELP text)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _split_principal(name: str):
+    """``principal/<field>/<principal>`` -> (field, principal), else
+    None.  maxsplit keeps any further ``/`` inside the principal."""
+    parts = name.split("/", 2)
+    if len(parts) == 3 and parts[0] == "principal":
+        return parts[1], parts[2]
+    return None
+
+
+def _principal_family(lines: List[str], field: str, kind: str,
+                      samples) -> None:
+    m = _sanitize(f"principal_{field}")
+    if kind == "counter":
+        m += "_total"
+    lines.append(f"# HELP {m} " + _escape_help(
+        f"Per-principal {field} from the query accounting plane "
+        "(obs.accounting)."))
+    lines.append(f"# TYPE {m} {kind}")
+    for principal, v in sorted(samples):
+        lines.append(
+            f'{m}{{principal="{_escape_label_value(principal)}"}}'
+            f' {_fmt(v)}')
+
+
 def to_openmetrics(registry: Optional[MetricsRegistry] = None) -> str:
     """Render a registry (default: the process-global one) in the
     Prometheus text exposition format, terminated by ``# EOF``."""
     reg = registry if registry is not None else metrics
     rep = reg.report()
     lines: List[str] = []
+    principals: dict = {}      # (field, kind) -> [(principal, value)]
     for name, v in sorted(rep["counters"].items()):
+        hit = _split_principal(name)
+        if hit is not None:
+            principals.setdefault((hit[0], "counter"), []) \
+                .append((hit[1], v))
+            continue
         m = _sanitize(name) + "_total"
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m} {_fmt(v)}")
     for name, v in sorted(rep["gauges"].items()):
+        hit = _split_principal(name)
+        if hit is not None:
+            principals.setdefault((hit[0], "gauge"), []) \
+                .append((hit[1], v))
+            continue
         m = _sanitize(name)
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m} {_fmt(v)}")
+    for (field, kind), samples in sorted(principals.items()):
+        _principal_family(lines, field, kind, samples)
     for name, h in sorted(reg.histograms().items()):
         m = _sanitize(name)
         lines.append(f"# TYPE {m} histogram")

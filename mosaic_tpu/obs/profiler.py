@@ -279,6 +279,13 @@ class KernelLedger:
             e["launches"] += 1
             e["seconds"] += float(seconds)
             e["rows"] += int(rows)
+        # query accounting join: observe() runs on the thread that
+        # launched the kernel, which carries the owning query's trace
+        # (obs.context thread propagation), so the same seconds charge
+        # the in-flight ticket — the per-principal device_s column
+        # (one empty-dict check when no query is registered)
+        from .inflight import charge_device_seconds
+        charge_device_seconds(float(seconds))
 
     def record_cost(self, name: str, figures: Dict[str, float]) -> None:
         """Attach XLA cost-analysis figures to every ``name`` entry."""

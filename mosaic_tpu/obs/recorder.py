@@ -183,6 +183,14 @@ class FlightRecorder:
             prof = capture_snapshot()                # ride along in
         except Exception:                            # every bundle
             prof = {}
+        try:                    # query console state: what was running
+            from .accounting import audit, meter     # at dump time +
+            from .inflight import inflight           # who spent what
+            queries = {"inflight": inflight.list_active(),
+                       "recent": audit.records(limit=50),
+                       "principals": meter.report()}
+        except Exception:
+            queries = {}
         b: Dict[str, Any] = {
             "reason": reason,
             "ts": time.time(),
@@ -193,6 +201,7 @@ class FlightRecorder:
             "timeseries": ts_snap,
             "memory": mem,
             "profile": prof,
+            "queries": queries,
             "config": cfg,
             "jax": _jax_info(),
         }

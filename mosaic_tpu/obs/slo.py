@@ -47,7 +47,7 @@ from .recorder import recorder
 from .timeseries import TimeSeriesStore, timeseries
 
 __all__ = ["SLObjective", "SLOMonitor", "monitor",
-           "default_objectives", "KINDS"]
+           "default_objectives", "principal_objectives", "KINDS"]
 
 KINDS = ("latency", "error_rate", "counter_rate", "gauge_max")
 
@@ -138,6 +138,28 @@ def default_objectives() -> List[SLObjective]:
         SLObjective(name="shard_skew", kind="gauge_max",
                     series="shard/skew/pip_join", ceiling=8.0,
                     windows=(60.0, 300.0)),
+    ]
+
+
+def principal_objectives(principal: str,
+                         query_ms_ceiling: float = 60_000.0,
+                         max_qps: float = 50.0) -> List[SLObjective]:
+    """The per-principal objective pair the accounting plane registers
+    on first sight of each principal (obs/accounting.py): a
+    ``gauge_max`` ceiling on the tenant's per-query latency series and
+    a ``counter_rate`` ceiling on its query rate.  Deliberately loose,
+    like :func:`default_objectives` — tenants get burn-rate alerting
+    with zero per-tenant config, operators tighten via
+    :meth:`SLOMonitor.add_objective` (same-name replace)."""
+    return [
+        SLObjective(name=f"principal_latency:{principal}",
+                    kind="gauge_max",
+                    series=f"principal/query_ms/{principal}",
+                    ceiling=query_ms_ceiling),
+        SLObjective(name=f"principal_qps:{principal}",
+                    kind="counter_rate",
+                    series=f"principal/queries/{principal}",
+                    max_rate=max_qps),
     ]
 
 

@@ -347,7 +347,10 @@ def make_streamed_pip_join(idx, grid: IndexSystem,
     def run(points64: np.ndarray):
         from ..obs import metrics, tracer
         from ..obs.context import root_trace
+        from ..obs.inflight import checkpoint
         from ..obs.profiler import ledger
+        checkpoint("pip_join/streamed")   # cancel before first chunk;
+        # stream() itself re-probes at every chunk boundary
         points64 = np.asarray(points64, np.float64)[:, :2]
         n = len(points64)
         zone_out = np.empty(n, np.int32)
@@ -555,6 +558,8 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
     def run(points64: np.ndarray):
         from ..obs import tracer
         from ..obs.context import root_trace
+        from ..obs.inflight import checkpoint
+        checkpoint("pip_join/sharded_streamed")
         points64 = np.asarray(points64, np.float64)[:, :2]
         n = len(points64)
         zone_out = np.empty(n, np.int32)

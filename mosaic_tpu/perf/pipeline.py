@@ -89,19 +89,29 @@ def stream(chunks: Sequence, compute: Callable,
     are disjoint and sum to (at most, and in steady state almost
     exactly) the pipeline's busy wall time.  This is the kernel
     ledger's wall-time feed (``obs.profiler``); callbacks run on the
-    single worker thread, in chunk order, and must not raise.
+    single worker thread, in chunk order.  An observer that raises
+    cannot kill the stream: the call is fenced — the error is counted
+    (``pipeline/observe_errors``) and flight-recorded once per stream,
+    and the chunk completes normally.
 
-    Exceptions from any stage propagate to the caller; the worker is
-    drained first so no device work is abandoned mid-flight."""
+    Cancellation: each loop iteration starts with an
+    ``obs.inflight.checkpoint`` probe, so a query cancelled (or past
+    its deadline) mid-stream stops within one chunk boundary.
+    Exceptions from any stage — including :class:`~..obs.inflight.
+    QueryCancelled` from the probe — propagate to the caller; the
+    worker is drained first so no device work is abandoned mid-flight
+    (the executor's ``with`` block joins the worker on the way out, so
+    a cancelled stream leaks no threads or in-flight device buffers)."""
     chunks = list(chunks)
     if not chunks:
         return []
     import time as _time
     import jax
+    from ..obs.inflight import charge_h2d_bytes, checkpoint, inflight
     if put is None:
         put = jax.device_put
     dispatch_ts: list = [0.0] * len(chunks)
-    obs_state = {"last_done": 0.0}
+    obs_state = {"last_done": 0.0, "observe_failed": False}
 
     def fetch(i, payload, out):
         faults.maybe_fail("pipeline.fetch")
@@ -110,7 +120,19 @@ def stream(chunks: Sequence, compute: Callable,
             now = _time.perf_counter()
             start = max(dispatch_ts[i], obs_state["last_done"])
             obs_state["last_done"] = now
-            observe(i, payload, now - start)
+            try:
+                observe(i, payload, now - start)
+            except Exception as exc:
+                # observability must never take down the data path:
+                # count every failure, flight-record the first per
+                # stream (single worker, so the flag is race-free)
+                metrics.count("pipeline/observe_errors")
+                if not obs_state["observe_failed"]:
+                    obs_state["observe_failed"] = True
+                    from ..obs import recorder
+                    recorder.record(
+                        "pipeline_observe_error", chunk=i,
+                        error=f"{type(exc).__name__}: {exc}")
         if metrics.enabled:         # device->host drain, per chunk
             metrics.count("pipeline/d2h_bytes", _tree_bytes(host))
         return consume(i, payload, host) if consume is not None \
@@ -118,8 +140,12 @@ def stream(chunks: Sequence, compute: Callable,
 
     def staged(payload):
         dev = put(payload)
-        if metrics.enabled:         # host->device staging, per chunk
-            metrics.count("pipeline/h2d_bytes", _tree_bytes(dev))
+        # the tree walk is skipped entirely when nothing is listening
+        if metrics.enabled or inflight._by_trace:
+            nb = _tree_bytes(dev)
+            if metrics.enabled:     # host->device staging, per chunk
+                metrics.count("pipeline/h2d_bytes", nb)
+            charge_h2d_bytes(nb)    # per-query attribution
         return dev
 
     results: list = [None] * len(chunks)
@@ -127,6 +153,12 @@ def stream(chunks: Sequence, compute: Callable,
         futs = []
         dev = staged(chunks[0])
         for i, payload in enumerate(chunks):
+            checkpoint("pipeline.stream")    # chunk-boundary cancel
+            # latency chaos: "pipeline.chunk" mode=delay stalls the
+            # dispatch loop (the cancellation drill's stall point —
+            # a cancel landing mid-stall raises at the NEXT chunk's
+            # checkpoint, one boundary later)
+            faults.stall("pipeline.chunk")
             dispatch_ts[i] = _time.perf_counter()
             out = compute(dev)
             if i + 1 < len(chunks):

@@ -182,6 +182,10 @@ class SQLSession:
         from ..functions.context import MosaicContext
         self.mc = context or MosaicContext.context()
         self._tables: Dict[str, Table] = {}
+        # Accounting identity: queries from this session are metered
+        # under this principal; None falls back to the
+        # ``mosaic.principal`` conf, then "anonymous" (obs/accounting).
+        self.principal: Optional[str] = None
 
     # -- catalog
     def create_table(self, name: str, columns: Dict[str, object]) -> Table:
@@ -222,22 +226,56 @@ class SQLSession:
         time lands as a ``sql/query_ms`` time-series point so the
         ``sql_latency`` burn-rate objective sees true per-query
         latency (``obs.slo``).  The ``sql.query`` fault site injects
-        deterministic stalls for alert drills."""
+        deterministic stalls for alert drills.
+
+        Accounting: the call registers a ticket in the in-flight
+        registry (``obs.inflight``) under ``session.principal`` /
+        ``mosaic.principal`` for its whole lifetime — visible in the
+        dashboard's ``/api/queries``, cancellable via
+        ``inflight.cancel(query_id)`` or the console, subject to
+        ``mosaic.query.deadline.ms``.  Cancellation is cooperative:
+        :class:`~..obs.inflight.QueryCancelled` rises from the next
+        operator boundary (or streamed-chunk boundary) and completes
+        the ticket with a *partial* cost record in the audit log
+        (outcome ``cancelled`` / ``deadline`` — never ``sql/errors``,
+        which stays reserved for unexpected service faults)."""
         from ..resilience import faults as _faults
+        from .. import config as _config
+        from ..obs.inflight import QueryCancelled, checkpoint, inflight
+        from ..obs import accounting as _accounting
         label = " ".join(query.split())[:60]
+        cfg = _config.default_config()
         t0 = time.perf_counter()
         with new_trace(f"sql:{label}") as ctx:
-            recorder.record("sql", query=label)
-            _faults.stall("sql.query")
-            metrics.count("sql/queries")
+            ticket = inflight.register(
+                label,
+                principal=self.principal or cfg.principal or "anonymous",
+                deadline_ms=cfg.query_deadline_ms)
+            outcome: str = "ok"
+            err: Optional[BaseException] = None
             try:
+                recorder.record("sql", query=label)
+                _faults.stall("sql.query")
+                metrics.count("sql/queries")
+                # a cancel/deadline that landed during the stall (or
+                # before any operator ran) surfaces here
+                checkpoint("sql")
                 with tracer.span("sql/query"):
                     out = self._sql_impl(query)
-            except SQLError:
+            except QueryCancelled as e:
+                outcome, err = e.outcome, e
+                raise               # operator action: not an SLO fault
+            except SQLError as e:
+                outcome, err = "error", e
                 raise               # client error: not an SLO fault
-            except Exception:
+            except Exception as e:
+                outcome, err = "error", e
                 metrics.count("sql/errors")
                 raise
+            finally:
+                _accounting.complete(
+                    ticket, outcome=outcome, error=err,
+                    wall_ms=(time.perf_counter() - t0) * 1e3)
         dt_ms = (time.perf_counter() - t0) * 1e3
         if metrics.enabled:
             from ..obs.timeseries import timeseries
@@ -350,8 +388,22 @@ class SQLSession:
         # coefficient store learns from this run (sql/planner.py)
         plan = planner.plan_query(q, self) if planner.enabled else None
         self._active_plan = plan
+        from ..obs.inflight import (checkpoint as _checkpoint,
+                                    note_rows as _note_rows,
+                                    note_rows_in as _note_rows_in,
+                                    note_strategies as _note_strategies)
+        if plan is not None:
+            # strategy picks land on the active ticket here (not read
+            # off self._active_plan at completion — that attribute is
+            # racy under concurrent sessions; the ticket is trace-local)
+            _note_strategies(
+                {op: plan.label(op) for op in plan.steps})
 
         def stage(op: str, detail: str, fn, rows_of):
+            # operator boundary: the cooperative cancellation probe —
+            # a cancel()/expired deadline raises QueryCancelled before
+            # the next operator starts, never mid-kernel
+            _checkpoint(op)
             # nested under the sql/query root span -> qualified as
             # sql/query/<op>, a child in the query's trace tree
             a2a0 = metrics.counter_value("collective/all_to_all_bytes")
@@ -362,6 +414,9 @@ class SQLSession:
                 res = fn()
                 dt = time.perf_counter() - t0
             rows = rows_of(res)
+            _note_rows(rows)
+            if op == "scan" or op.endswith("_join"):
+                _note_rows_in(rows)
             step = plan.steps.get(op) if plan is not None else None
             if step is not None:
                 planner.observe_step(step, rows, dt)

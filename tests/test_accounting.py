@@ -1,0 +1,484 @@
+"""The query accounting plane (``obs.inflight`` + ``obs.accounting``).
+
+Covers the acceptance surface of the accounting PR: ticket lifecycle
+through ``SQLSession.sql()`` (principal resolution, cost vector,
+planner strategies in the audit record), cooperative cancellation at
+operator and streamed-chunk boundaries (partial cost record, no
+leaked worker threads), deadline expiry, per-principal meter splits
+under concurrent interleaved queries, device-seconds attribution
+joined from the kernel ledger, the audit JSONL spool, per-principal
+SLO auto-registration, OpenMetrics label escaping with a hostile
+principal name, the pipeline ``observe`` hardening, and the
+dashboard's query console routes (JSON 404 / 405 / no-store).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mosaic_tpu as mos
+from mosaic_tpu import config as _config
+from mosaic_tpu.obs import metrics, recorder
+from mosaic_tpu.obs.accounting import accounted, audit, meter
+from mosaic_tpu.obs.inflight import (QueryCancelled, QueryTicket,
+                                     checkpoint, inflight)
+from mosaic_tpu.obs.profiler import ledger
+from mosaic_tpu.obs.slo import monitor
+from mosaic_tpu.resilience import faults
+from mosaic_tpu.sql import SQLError, SQLSession
+
+
+@pytest.fixture
+def clean_acct():
+    """Reset the accounting singletons around each test (the registry
+    itself holds no state once every query completes)."""
+    audit.reset()
+    meter.reset()
+    metrics.reset()
+    metrics.enable()
+    recorder.reset()
+    recorder.enable()
+    yield
+    faults.disarm()
+    audit.reset()
+    meter.reset()
+    metrics.disable()
+    metrics.reset()
+    recorder.reset()
+
+
+@pytest.fixture
+def clean_config():
+    prev = _config.default_config()
+    yield
+    _config.set_default_config(prev)
+
+
+@pytest.fixture
+def session():
+    ctx = mos.enable_mosaic("CUSTOM(-180,180,-90,90,2,360,180)")
+    s = mos.SQLSession(ctx)
+    s.create_table("pts", {"x": np.arange(100.0),
+                           "y": np.arange(100.0) / 10.0})
+    return s
+
+
+def _streamed_join():
+    """A tiny warm streamed PIP join (the flagship shape)."""
+    from mosaic_tpu import read_wkt
+    from mosaic_tpu.core.index.custom import CustomIndexSystem, GridConf
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                              make_streamed_pip_join)
+    grid = CustomIndexSystem(GridConf(0, 16, 0, 16, 2, 1.0, 1.0))
+    arr = read_wkt(
+        ["POLYGON ((1.3 1.7, 6.8 2.1, 5.9 6.3, 2.2 5.8, 1.3 1.7))",
+         "POLYGON ((8.5 1.5, 14.5 1.5, 14.5 6.5, 8.5 6.5, 8.5 1.5))"])
+    idx = build_pip_index(arr, 1, grid, chips=tessellate(arr, 1, grid))
+    pts = np.random.default_rng(3).uniform(0, 16, (8192, 2))
+    sjoin = make_streamed_pip_join(idx, grid, polys=arr, chunk=2048)
+    sjoin(pts)                                # warm (compile)
+    return sjoin, pts
+
+
+# ----------------------------------------------------- ticket basics
+
+def test_sql_writes_one_audit_record_with_cost_and_strategies(
+        clean_acct, session):
+    session.principal = "alice"
+    out = session.sql("SELECT x FROM pts WHERE x > 50")
+    assert len(out) == 49
+    recs = audit.records()
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["principal"] == "alice"
+    assert r["outcome"] == "ok"
+    assert r["cost"]["rows_in"] == 100
+    assert r["cost"]["rows_out"] == 49
+    assert r["cost"]["wall_ms"] > 0
+    assert "scan" in r["strategies"]          # planner picks ride along
+    assert r["trace"] and r["query_id"].startswith("q")
+    assert not inflight.list_active()         # ticket closed
+    m = meter.report()["alice"]
+    assert m["queries"] == 1 and m["rows_out"] == 49
+    assert m["outcomes"] == {"ok": 1}
+
+
+def test_principal_resolution_conf_then_anonymous(
+        clean_acct, clean_config, session):
+    session.principal = None
+    session.sql("SET mosaic.principal = team-geo")
+    session.sql("SELECT x FROM pts LIMIT 1")
+    assert audit.records()[-1]["principal"] == "team-geo"
+    session.sql("SET mosaic.principal = ''")
+    session.sql("SELECT x FROM pts LIMIT 1")
+    assert audit.records()[-1]["principal"] == "anonymous"
+    session.principal = "alice"               # session attr wins
+    session.sql("SELECT x FROM pts LIMIT 1")
+    assert audit.records()[-1]["principal"] == "alice"
+
+
+def test_error_outcomes_split_client_vs_service(clean_acct, session):
+    session.principal = "alice"
+    with pytest.raises(SQLError):
+        session.sql("SELECT nope FROM pts")
+    r = audit.records()[-1]
+    assert r["outcome"] == "error" and "nope" in r["error"]
+    # client mistakes stay out of the service-fault SLO feed
+    assert metrics.counter_value("sql/errors") == 0
+    assert meter.report()["alice"]["outcomes"]["error"] == 1
+    assert not inflight.list_active()
+
+
+def test_disabled_registry_is_a_no_op(clean_acct, session):
+    session.principal = "alice"
+    inflight.enabled = False
+    try:
+        out = session.sql("SELECT x FROM pts LIMIT 3")
+        assert len(out) == 3                  # queries still run
+        assert audit.records() == []          # nothing accounted
+        assert meter.report() == {}
+    finally:
+        inflight.enabled = True
+
+
+def test_ticket_deadline_check_raises_deadline_outcome():
+    t = QueryTicket("q-test", "p", "SELECT 1", "trace-x",
+                    deadline_ms=1.0)
+    time.sleep(0.01)
+    with pytest.raises(QueryCancelled) as ei:
+        t.check()
+    assert ei.value.outcome == "deadline"
+    assert ei.value.query_id == "q-test"
+    # not an SQLError: cancellation is an operator action
+    assert not isinstance(ei.value, SQLError)
+
+
+def test_checkpoint_is_noop_outside_any_query(clean_acct):
+    checkpoint("anywhere")                    # must not raise
+
+
+# ----------------------------------------------------- cancellation
+
+def test_cancel_stalled_sql_query_mid_flight(clean_acct, session):
+    session.principal = "alice"
+    faults.arm("site=sql.query,mode=delay,fails=1,delay_ms=700")
+    n0 = threading.active_count()
+    res = {}
+
+    def run():
+        try:
+            session.sql("SELECT x FROM pts")
+        except QueryCancelled as e:
+            res["exc"] = e
+
+    th = threading.Thread(target=run)
+    th.start()
+    deadline = time.time() + 0.5
+    act = []
+    while not act and time.time() < deadline:
+        act = inflight.list_active()
+        time.sleep(0.01)
+    assert act and act[0]["principal"] == "alice"
+    assert inflight.cancel(act[0]["query_id"])
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert res["exc"].outcome == "cancelled"
+    r = audit.records()[-1]
+    assert r["outcome"] == "cancelled"
+    assert metrics.counter_value("sql/errors") == 0
+    assert recorder.events("query_cancel_requested")
+    assert threading.active_count() <= n0 + 1
+    assert not inflight.list_active()
+
+
+def test_cancel_streamed_join_within_one_chunk_boundary(clean_acct):
+    """The acceptance drill: a stalled streamed query cancelled
+    mid-stream stops at the next chunk boundary with a partial cost
+    record and no leaked worker threads."""
+    sjoin, pts = _streamed_join()
+    faults.arm("site=pipeline.chunk,mode=delay,fails=1,delay_ms=700")
+    n0 = threading.active_count()
+    res = {}
+
+    def run():
+        try:
+            with accounted("stalled-join", principal="bob"):
+                sjoin(pts)
+        except QueryCancelled as e:
+            res["exc"] = e
+
+    th = threading.Thread(target=run)
+    th.start()
+    deadline = time.time() + 0.5
+    act = []
+    while not act and time.time() < deadline:
+        act = inflight.list_active()
+        time.sleep(0.01)
+    assert act
+    t0 = time.perf_counter()
+    assert inflight.cancel(act[0]["query_id"])
+    th.join(timeout=10)
+    assert not th.is_alive()
+    # one chunk boundary: the 700 ms stall plus slack, not the whole
+    # 4-chunk stream stalled once per chunk
+    assert time.perf_counter() - t0 < 5.0
+    assert res["exc"].outcome == "cancelled"
+    r = audit.records()[-1]
+    assert r["outcome"] == "cancelled"
+    assert r["cost"]["wall_ms"] > 0           # partial, not empty
+    assert r["cost"]["h2d_bytes"] > 0         # chunk 0 was staged
+    time.sleep(0.2)                           # executor teardown
+    assert threading.active_count() <= n0 + 1
+    assert not inflight.list_active()
+
+
+def test_deadline_expires_during_stall(clean_acct, clean_config,
+                                       session):
+    session.principal = "alice"
+    cfg = _config.apply_conf(_config.default_config(),
+                             "mosaic.query.deadline.ms", "100")
+    _config.set_default_config(cfg)
+    faults.arm("site=sql.query,mode=delay,fails=1,delay_ms=300")
+    with pytest.raises(QueryCancelled) as ei:
+        session.sql("SELECT x FROM pts")
+    assert ei.value.outcome == "deadline"
+    assert audit.records()[-1]["outcome"] == "deadline"
+    assert meter.report()["alice"]["outcomes"] == {"deadline": 1}
+
+
+# ------------------------------------------ concurrency + attribution
+
+def test_concurrent_queries_get_disjoint_tickets_and_splits(
+        clean_acct):
+    """Two principals in two threads: disjoint query ids and traces,
+    correct per-principal meter splits."""
+    ctx = mos.enable_mosaic("CUSTOM(-180,180,-90,90,2,360,180)")
+    barrier = threading.Barrier(2)
+    seen = {}
+
+    def worker(principal, n_rows):
+        s = mos.SQLSession(ctx)
+        s.principal = principal
+        s.create_table("t", {"a": np.arange(float(n_rows))})
+        barrier.wait()
+        for _ in range(3):
+            s.sql("SELECT a FROM t WHERE a >= 0")
+        seen[principal] = n_rows
+
+    t1 = threading.Thread(target=worker, args=("alice", 50))
+    t2 = threading.Thread(target=worker, args=("bob", 80))
+    t1.start(); t2.start(); t1.join(10); t2.join(10)
+    assert seen == {"alice": 50, "bob": 80}
+    recs = audit.records()
+    assert len(recs) == 6
+    assert len({r["query_id"] for r in recs}) == 6     # disjoint ids
+    assert len({r["trace"] for r in recs}) == 6        # disjoint traces
+    rep = meter.report()
+    assert rep["alice"]["queries"] == 3
+    assert rep["alice"]["rows_out"] == 150
+    assert rep["bob"]["queries"] == 3
+    assert rep["bob"]["rows_out"] == 240
+
+
+def test_device_seconds_attribute_to_the_owning_principal(clean_acct):
+    """The ledger->ticket join: >= 90% of measured ledger time lands
+    on the principal that ran the work (acceptance floor)."""
+    sjoin, pts = _streamed_join()
+    ledger.reset()
+    with accounted("join-a", principal="alice"):
+        sjoin(pts)
+    with accounted("join-b", principal="bob"):
+        sjoin(pts)
+        sjoin(pts)
+    total = ledger.seconds("pip/streamed")
+    rep = meter.report()
+    attributed = rep["alice"]["device_s"] + rep["bob"]["device_s"]
+    assert total > 0
+    assert attributed >= 0.9 * total
+    # and the split leans the right way: bob ran 2 of 3 passes
+    assert rep["bob"]["device_s"] > rep["alice"]["device_s"]
+
+
+def test_accounted_charges_h2d_and_registers_slos(clean_acct):
+    sjoin, pts = _streamed_join()
+    with accounted("join", principal="carol"):
+        sjoin(pts)
+    assert meter.report()["carol"]["h2d_bytes"] > 0
+    names = {o.name for o in monitor.objectives()}
+    assert "principal_latency:carol" in names
+    assert "principal_qps:carol" in names
+    # principal series got the per-query latency point
+    from mosaic_tpu.obs.timeseries import timeseries
+    s = timeseries.series("principal/query_ms/carol")
+    assert s is not None and s.raw
+
+
+# ----------------------------------------------------- audit log
+
+def test_audit_ring_is_bounded_and_filterable(clean_acct):
+    small = type(audit)(capacity=4)
+    for i in range(10):
+        small.append({"query_id": f"q{i}", "principal": "p",
+                      "outcome": "ok" if i % 2 else "error"})
+    assert small.written() == 10
+    assert len(small.records()) == 4          # ring keeps the tail
+    assert [r["query_id"] for r in small.records(limit=2)] \
+        == ["q8", "q9"]
+    assert all(r["outcome"] == "ok"
+               for r in small.records(outcome="ok"))
+
+
+def test_audit_spool_writes_jsonl(clean_acct, clean_config, session,
+                                  tmp_path):
+    spool = tmp_path / "audit.jsonl"
+    session.principal = "alice"
+    session.sql(f"SET mosaic.audit.path = {spool}")
+    session.sql("SELECT x FROM pts LIMIT 2")
+    session.sql("SELECT x FROM pts LIMIT 3")
+    lines = [json.loads(ln) for ln
+             in spool.read_text().strip().splitlines()]
+    # the SET itself may spool depending on ordering; the two SELECTs
+    # must be the last two records
+    assert len(lines) >= 2
+    assert [r["cost"]["rows_out"] for r in lines[-2:]] == [2, 3]
+    assert all(r["principal"] == "alice" for r in lines[-2:])
+
+
+# ----------------------------------------------------- openmetrics
+
+def test_openmetrics_escapes_hostile_principal_label(clean_acct):
+    hostile = 'evil"name\nwith\\stuff'
+    meter.charge(hostile, {"wall_ms": 5.0})
+    from mosaic_tpu.obs.openmetrics import to_openmetrics
+    txt = to_openmetrics()
+    want = 'mosaic_principal_queries_total{principal=' \
+        '"evil\\"name\\nwith\\\\stuff"} 1'
+    assert want in txt
+    # no raw newline/quote leaks into any sample line
+    for ln in txt.splitlines():
+        if "principal=" in ln:
+            assert "\n" not in ln
+    # HELP lines are escaped too (never a raw newline mid-line)
+    helps = [ln for ln in txt.splitlines()
+             if ln.startswith("# HELP mosaic_principal_")]
+    assert helps
+
+
+def test_openmetrics_principal_series_share_one_family(clean_acct):
+    meter.charge("a", {"wall_ms": 1.0})
+    meter.charge("b", {"wall_ms": 2.0})
+    from mosaic_tpu.obs.openmetrics import to_openmetrics
+    txt = to_openmetrics()
+    fam = [ln for ln in txt.splitlines()
+           if ln.startswith("mosaic_principal_queries_total{")]
+    assert len(fam) == 2                      # one labeled series each
+    assert txt.count("# TYPE mosaic_principal_queries_total") == 1
+
+
+# ----------------------------------------------------- pipeline
+
+def test_raising_observer_does_not_kill_the_stream(clean_acct):
+    from mosaic_tpu.perf.pipeline import stream
+    chunks = [np.arange(4.0), np.arange(4.0) + 4]
+
+    def bad_observe(i, payload, seconds):
+        raise RuntimeError("observer bug")
+
+    out = stream(chunks, compute=lambda x: x * 2,
+                 observe=bad_observe)
+    np.testing.assert_allclose(out[0], chunks[0] * 2)
+    np.testing.assert_allclose(out[1], chunks[1] * 2)
+    assert metrics.counter_value("pipeline/observe_errors") == 2
+    # flight-recorded once per stream, not once per chunk
+    assert len(recorder.events("pipeline_observe_error")) == 1
+
+
+# ----------------------------------------------------- dashboard
+
+def _req(base, path, method="GET"):
+    req = urllib.request.Request(base + path, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_dashboard_query_console_routes(clean_acct, session):
+    from mosaic_tpu.obs import serve_dashboard
+    session.principal = "carol"
+    session.sql("SELECT x FROM pts LIMIT 5")
+    with serve_dashboard(port=0) as h:
+        base = f"http://127.0.0.1:{h.port}"
+        st, hd, body = _req(base, "/api/queries")
+        assert st == 200
+        assert hd.get("Cache-Control") == "no-store"
+        q = json.loads(body)
+        assert q["inflight"] == []
+        assert q["recent"][-1]["principal"] == "carol"
+        st, hd, body = _req(base, "/api/principals")
+        assert st == 200 and hd.get("Cache-Control") == "no-store"
+        assert json.loads(body)["principals"]["carol"]["queries"] == 1
+        # unknown /api/* -> JSON 404, still no-store
+        st, hd, body = _req(base, "/api/bogus")
+        assert st == 404
+        assert hd.get("Cache-Control") == "no-store"
+        assert json.loads(body)["error"] == "not found"
+        # cancel is POST-only
+        st, hd, body = _req(base, "/api/queries/qx/cancel")
+        assert st == 405 and hd.get("Allow") == "POST"
+        st, _, body = _req(base, "/api/queries/qx/cancel", "POST")
+        assert st == 404
+        assert json.loads(body) == {"query_id": "qx",
+                                    "cancelled": False}
+        # the console sections are on the page
+        st, _, body = _req(base, "/")
+        assert st == 200 and b"Queries in flight" in body
+
+
+def test_dashboard_cancels_live_query_via_post(clean_acct, session):
+    from mosaic_tpu.obs import serve_dashboard
+    session.principal = "carol"
+    faults.arm("site=sql.query,mode=delay,fails=1,delay_ms=700")
+    res = {}
+
+    def run():
+        try:
+            session.sql("SELECT x FROM pts")
+        except QueryCancelled as e:
+            res["exc"] = e
+
+    with serve_dashboard(port=0) as h:
+        base = f"http://127.0.0.1:{h.port}"
+        th = threading.Thread(target=run)
+        th.start()
+        deadline = time.time() + 0.5
+        q = []
+        while not q and time.time() < deadline:
+            q = json.loads(_req(base, "/api/queries")[2])["inflight"]
+            time.sleep(0.01)
+        assert q and q[0]["principal"] == "carol"
+        st, _, body = _req(
+            base, f"/api/queries/{q[0]['query_id']}/cancel", "POST")
+        assert st == 200 and json.loads(body)["cancelled"] is True
+        th.join(timeout=10)
+    assert res["exc"].outcome == "cancelled"
+    assert audit.records()[-1]["outcome"] == "cancelled"
+
+
+# ----------------------------------------------------- recorder bundle
+
+def test_flight_bundle_carries_query_console_state(clean_acct,
+                                                   session):
+    session.principal = "alice"
+    session.sql("SELECT x FROM pts LIMIT 1")
+    b = recorder.bundle(reason="test")
+    assert b["queries"]["recent"][-1]["principal"] == "alice"
+    assert b["queries"]["principals"]["alice"]["queries"] == 1
+    assert b["queries"]["inflight"] == []

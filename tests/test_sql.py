@@ -427,3 +427,53 @@ def test_explain_analyze_sharded_columns(session, mc):
         mc.use_mesh(None)
         if not was:
             metrics.disable()
+
+
+def test_concurrent_queries_interleave_with_disjoint_accounting(mc):
+    """Satellite of the accounting plane: two sessions querying from
+    two threads get disjoint query tickets, disjoint per-trace span
+    profiles, and per-principal meter splits that add up."""
+    import threading
+
+    from mosaic_tpu.obs import metrics, tracer
+    from mosaic_tpu.obs.accounting import audit, meter
+    audit.reset(); meter.reset()
+    metrics.reset(); metrics.enable(); tracer.enable()
+    barrier = threading.Barrier(2)
+
+    def worker(principal, n):
+        s = SQLSession(mc)
+        s.principal = principal
+        s.create_table("t", {"v": np.arange(float(n))})
+        barrier.wait()
+        for _ in range(4):
+            s.sql("SELECT v FROM t WHERE v < 1e9")
+
+    try:
+        ts = [threading.Thread(target=worker, args=("alice", 30)),
+              threading.Thread(target=worker, args=("bob", 70))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        recs = audit.records()
+        assert len(recs) == 8
+        assert len({r["query_id"] for r in recs}) == 8
+        assert len({r["trace"] for r in recs}) == 8
+        # every query's spans landed under its OWN trace: the span
+        # profile for each audited trace exists and none is shared
+        traces = tracer.report()["traces"]
+        for r in recs:
+            assert r["trace"] in traces
+            assert traces[r["trace"]]["spans"]
+        rep = meter.report()
+        assert rep["alice"]["queries"] == 4
+        assert rep["bob"]["queries"] == 4
+        assert rep["alice"]["rows_out"] == 4 * 30
+        assert rep["bob"]["rows_out"] == 4 * 70
+        assert rep["alice"]["outcomes"] == {"ok": 4}
+    finally:
+        tracer.disable(); tracer.reset()
+        metrics.disable(); metrics.reset()
+        audit.reset(); meter.reset()
