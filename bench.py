@@ -209,7 +209,7 @@ def perf_guard(current: dict, platform: str, slip: float = 0.20,
         return []
     tags = "+".join(tag for tag, _ in hist)
     lower_better = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
-                    "planner_flagship_ms",
+                    "planner_flagship_ms", "fused_flagship_ms",
                     "sharded_end_to_end_ms",
                     "tessellate_zones_s",
                     "tessellate_counties_s", "overlay_s",
@@ -661,6 +661,109 @@ def main():
         f"{planner_rep['mispredicts']} mispredicts, estimate-error "
         f"p95 {planner_rep['estimate_error_p95']}")
 
+    # ------------------------------ whole-query fusion A/B
+    # Flagship reference query through the SQL engine with the fusion
+    # pass (perf/fusion.py) pinned on vs off.  Calibrated: both paths
+    # warm before timing, so the fused numbers measure the steady
+    # state (one compile per (group, size-class), already cached) and
+    # the delta is purely the eliminated per-stage host round-trips.
+    # Every A/B'd query is parity-asserted bit for bit — fusion is a
+    # strategy transform, never an answer transform — and the fused
+    # reps assert exactly ONE device->host fetch per query plus zero
+    # XLA compiles once warm.
+    from mosaic_tpu.functions.context import MosaicContext as _MCtx
+    from mosaic_tpu.sql import SQLSession as _SQLSession
+    try:
+        _MCtx.context()
+    except RuntimeError:
+        _MCtx.build(grid)
+        _config.set_default_config(_config.apply_conf(
+            _config.default_config(), "mosaic.stream.chunk.rows",
+            chunk))
+
+    def _pin_fusion(mode):
+        _config.set_default_config(_config.apply_conf(
+            _config.default_config(), "mosaic.planner.force.fusion",
+            mode))
+
+    fusion_n = (1 << 14) if smoke else (1 << 19)
+    _frng = np.random.default_rng(2026)
+    _fsess = _SQLSession()
+    _fsess.create_table("fpts", {
+        "px": _frng.normal(size=fusion_n),
+        "py": _frng.normal(size=fusion_n),
+        "k": _frng.integers(0, 1000, size=fusion_n)})
+    _FQ = ("SELECT count(*) AS n, max(px) AS mx, min(py) AS mn, "
+           "sum(k) AS sk FROM fpts "
+           "WHERE px*px + py*py < 1.44 AND px > 0.1")
+    _PQ = ("SELECT px + py AS s, px * 0.5 AS h FROM fpts "
+           "WHERE k < 500 AND py > 0.0")
+
+    def _timed(query, reps=5):
+        for _ in range(2):
+            out = _fsess.sql(query)
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            out = _fsess.sql(query)
+            times.append(time.time() - t0)
+        return float(np.median(times)) * 1e3, out
+
+    def _parity(a, b):
+        bad = 0
+        for name in a.columns:
+            x = np.asarray(a.columns[name])
+            y = np.asarray(b.columns[name])
+            if x.dtype != y.dtype or not np.array_equal(
+                    x, y, equal_nan=True):
+                bad += 1
+        return bad + (0 if list(a.columns) == list(b.columns) else 1)
+
+    fusion_rec = {"n": fusion_n}
+    with tracer.span("bench/fusion_ab"):
+        _pin_fusion("on")
+        _fsess.sql(_FQ)              # cold: the one group compile
+        _kc0 = kernel_cache.stats()
+        _fx0 = metrics.counter_value("fusion/fetches")
+        fused_ms, fused_out = _timed(_FQ)
+        _kc1 = kernel_cache.stats()
+        _fx1 = metrics.counter_value("fusion/fetches")
+        fused_fetches = int(_fx1 - _fx0)
+        warm_compiles = int(_kc1["misses"] - _kc0["misses"])
+        # 7 runs total (2 warm + 5 timed): one fetch per query, zero
+        # compiles — the intermediate-transfer elimination the fused
+        # path exists for, asserted rather than assumed
+        assert fused_fetches == 7, \
+            f"expected 1 fetch/query (7 total), saw {fused_fetches}"
+        assert warm_compiles == 0, \
+            f"warm fused reps compiled {warm_compiles}x"
+        _pin_fusion("off")
+        unfused_ms, unfused_out = _timed(_FQ)
+        flag_par = _parity(fused_out, unfused_out)
+        assert flag_par == 0, "fusion parity broke on flagship query"
+        _pin_fusion("on")
+        pf_ms, pf_out = _timed(_PQ)
+        _pin_fusion("off")
+        pu_ms, pu_out = _timed(_PQ)
+        proj_par = _parity(pf_out, pu_out)
+        assert proj_par == 0, "fusion parity broke on project query"
+        _pin_fusion("auto")
+    fusion_rec.update({
+        "fused_flagship_ms": round(fused_ms, 2),
+        "unfused_flagship_ms": round(unfused_ms, 2),
+        "speedup": round(unfused_ms / fused_ms, 3) if fused_ms
+        else None,
+        "parity_mismatches": flag_par + proj_par,
+        "fetches_per_query": 1,
+        "warm_compiles": warm_compiles,
+        "project_fused_ms": round(pf_ms, 2),
+        "project_unfused_ms": round(pu_ms, 2)})
+    log(f"fusion A/B n={fusion_n}: flagship fused {fused_ms:.2f} ms "
+        f"vs unfused {unfused_ms:.2f} ms "
+        f"({unfused_ms / fused_ms:.2f}x); project fused "
+        f"{pf_ms:.2f} ms vs {pu_ms:.2f} ms; parity 0; warm compiles 0")
+    _fsess.drop_table("fpts")
+
     obs_rep = tracer.report()
     p95_ms = round(obs_rep["spans"]
                    .get("bench/flagship_join", {})
@@ -696,6 +799,12 @@ def main():
         "planner": dict(planner_rep, sweep=sweep),
         "planner_flagship_ms": round(planner_large_ms, 2)
         if planner_large_ms else None,
+        # whole-query fusion A/B (perf/fusion.py): the flagship
+        # reference query fused vs unfused, parity- and
+        # transfer-asserted above; fused_flagship_ms joins the
+        # perf guard
+        "fusion": fusion_rec,
+        "fused_flagship_ms": fusion_rec["fused_flagship_ms"],
         "multichip": {
             "n_devices": len(devs),
             "rc": 0,

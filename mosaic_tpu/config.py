@@ -89,6 +89,14 @@ MOSAIC_PLANNER_FORCE_PREFIX = "mosaic.planner.force."
 # brute-right-max row threshold; models/knn.py).
 MOSAIC_STREAM_CHUNK_ROWS = "mosaic.stream.chunk.rows"
 MOSAIC_KNN_STRATEGY = "mosaic.knn.strategy"
+# Whole-query fusion (perf/fusion.py): compile adjacent eligible SQL
+# operators into one XLA program per (group signature, size bucket).
+# A pure strategy transform (bit-identical results), so `enabled`
+# defaults on; the planner still gates each query per size class
+# ("mosaic.planner.force.fusion" = on/off pins the gate).  `max.ops`
+# caps group length — longer runs drop their earliest members.
+MOSAIC_FUSION_ENABLED = "mosaic.fusion.enabled"
+MOSAIC_FUSION_MAX_OPS = "mosaic.fusion.max.ops"
 # Query accounting plane (obs/inflight.py + obs/accounting.py): the
 # principal every query from this config is attributed to (session
 # attribute `SQLSession.principal` overrides it; "" -> "anonymous"),
@@ -183,6 +191,11 @@ class MosaicConfig:
     stream_chunk_rows: int = 262_144
     # "auto" | "brute" | "ring" | positive-int brute-right-max.
     knn_strategy: str = "auto"
+    # Whole-query fusion master switch (perf/fusion.py).  Off = every
+    # operator dispatches separately, as before the fusion pass.
+    fusion_enabled: bool = True
+    # Fusion group-size cap (member operators per compiled group).
+    fusion_max_ops: int = 8
     # Principal queries under this config are metered as ("" falls
     # back to "anonymous"; SQLSession.principal overrides per session).
     principal: str = ""
@@ -314,6 +327,8 @@ _CONF_FIELDS = {
     MOSAIC_PLANNER_STATS_PATH: ("planner_stats_path", _as_str),
     MOSAIC_STREAM_CHUNK_ROWS: ("stream_chunk_rows", _as_blocksize),
     MOSAIC_KNN_STRATEGY: ("knn_strategy", _as_knn_strategy),
+    MOSAIC_FUSION_ENABLED: ("fusion_enabled", _as_flag),
+    MOSAIC_FUSION_MAX_OPS: ("fusion_max_ops", _as_blocksize),
     MOSAIC_PRINCIPAL: ("principal", _as_str),
     MOSAIC_QUERY_DEADLINE_MS: ("query_deadline_ms", _as_millis),
     MOSAIC_AUDIT_PATH: ("audit_path", _as_str),

@@ -344,10 +344,13 @@ async function tick(){
  const L=p.ledger||{kernels:[]};
  $("ledger").innerHTML="<tr><th>kernel</th><th>key</th>"+
   "<th>launches</th><th>seconds</th><th>rows/s</th><th>gflops/s</th>"+
-  "</tr>"+L.kernels.map(k=>"<tr><td>"+k.name+"</td><td><code>"+
+  "</tr>"+L.kernels.map(k=>{const f=k.name.startsWith("fused:");
+   return "<tr"+(f?' style="background:#eef6ee"':"")+"><td>"+
+   (f?"<b>"+k.name+"</b> <span style=\"color:#484\">⧉</span>":k.name)+
+   "</td><td><code>"+
    k.key.slice(0,60)+"</code></td><td>"+k.launches+"</td><td>"+
    k.seconds.toFixed(4)+"</td><td>"+(k.rows_per_s||"-")+"</td><td>"+
-   (k.gflops_s||"-")+"</td></tr>").join("");
+   (k.gflops_s||"-")+"</td></tr>"}).join("");
 }
 tick();setInterval(tick,3000);
 </script></body></html>

@@ -20,6 +20,13 @@ applied to the chipping/join hot path:
   host-side consumption (f64 recheck, re-rank) of chunk N−1 runs on a
   worker thread.  Used by the streamed PIP join, the KNN brute-force
   top-k and the multi-tile raster halo convolve.
+* ``perf.fusion`` — whole-query fusion for the SQL engine: adjacent
+  size-class-compatible operators (filter → project/aggregate) compile
+  into ONE jitted XLA program keyed into ``kernel_cache`` as
+  ``fused:<opset>:<sig>``, with zero intermediate host transfers and
+  bit-for-bit parity with the unfused path.  Planner-gated per query
+  (``decide_fusion``, conf ``mosaic.fusion.enabled``).  Imported
+  lazily by ``sql.planner`` — not re-exported here.
 """
 
 from __future__ import annotations

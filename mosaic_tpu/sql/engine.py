@@ -317,13 +317,18 @@ class SQLSession:
         if q.explain == "plan":
             ops = self._plan_ops(q)
             # strategy column: the planner's chosen path + why per
-            # operator ("-" when the planner is off or has no choice)
+            # operator ("-" when the planner is off or has no choice);
+            # fused column: the fusion group id each operator compiles
+            # into ("-" = dispatches alone — see perf/fusion.py)
             plan = planner.plan_query(q, self) if planner.enabled \
                 else None
+            fplan = plan.fusion if plan is not None else None
             return Table({"operator": [o for o, _ in ops],
                           "detail": [d for _, d in ops],
                           "strategy": [plan.label(o) if plan is not None
-                                       else "-" for o, _ in ops]})
+                                       else "-" for o, _ in ops],
+                          "fused": [fplan.gid_for(o) if fplan is not None
+                                    else "-" for o, _ in ops]})
         if q.explain == "analyze":
             prof: List[tuple] = []
             self._execute(q, prof)
@@ -335,7 +340,10 @@ class SQLSession:
             # next to actual rows so mispredicts read off per operator;
             # device_ms is the per-device wall-time split the device
             # monitor attributed while the stage ran ("-" when the
-            # operator never touched a mesh — see obs.devicemon)
+            # operator never touched a mesh — see obs.devicemon);
+            # fused marks the operators a fusion group executed as one
+            # XLA program — the group's device/wall time rolls up on
+            # its FIRST member's row, later members just unpack
             return Table({"operator": [p[0] for p in prof],
                           "detail": [p[1] for p in prof],
                           "rows": np.asarray([p[2] for p in prof],
@@ -348,7 +356,8 @@ class SQLSession:
                               [p[4] for p in prof], np.int64),
                           "shard_skew": np.asarray(
                               [p[5] for p in prof]),
-                          "device_ms": [p[7] for p in prof]})
+                          "device_ms": [p[7] for p in prof],
+                          "fused": [p[8] for p in prof]})
         return self._execute(q, None)
 
     def _plan_ops(self, q: Query) -> List[tuple]:
@@ -399,7 +408,35 @@ class SQLSession:
             _note_strategies(
                 {op: plan.label(op) for op in plan.steps})
 
-        def stage(op: str, detail: str, fn, rows_of):
+        # fusion: the planner's pre-pass may have stitched adjacent
+        # eligible operators into one XLA program (perf/fusion.py).
+        # The group runs inside its FIRST member's stage; later member
+        # stages just unpack the cached FusedResult.  A runtime
+        # bailout (dtype drift, sum bound, x64 off) latches "bailed"
+        # and every member falls back to the unfused path — results
+        # stay bit-for-bit identical either way.
+        fplan = plan.fusion if plan is not None else None
+        fstate = {"res": None, "bailed": False}
+
+        def _try_group(g, genv):
+            from ..perf import fusion as _fusion
+            try:
+                fstate["res"] = _fusion.execute_group(g, q, genv, self)
+                return fstate["res"]
+            except _fusion.FusionBailout as e:
+                fstate["bailed"] = True
+                if metrics.enabled:
+                    metrics.count("fusion/bailouts")
+                recorder.record("fusion_bailout", group=g.gid,
+                                reason=str(e))
+                return None
+
+        def _fused_gid(op: str) -> str:
+            if fplan is None or fstate["res"] is None:
+                return "-"
+            return fplan.gid_for(op)
+
+        def stage(op: str, detail: str, fn, rows_of, fused_of=None):
             # operator boundary: the cooperative cancellation probe —
             # a cancel()/expired deadline raises QueryCancelled before
             # the next operator starts, never mid-kernel
@@ -417,9 +454,21 @@ class SQLSession:
             _note_rows(rows)
             if op == "scan" or op.endswith("_join"):
                 _note_rows_in(rows)
+            gid = fused_of() if fused_of is not None else "-"
             step = plan.steps.get(op) if plan is not None else None
             if step is not None:
-                planner.observe_step(step, rows, dt)
+                if gid != "-":
+                    # the stage ran inside a fusion group: its wall
+                    # time belongs to the group's fusion/<opset> cost
+                    # key (fed by execute_group), so only close the
+                    # cardinality side here — feeding dt to the member
+                    # op would poison the unfused coefficient the
+                    # fusion gate compares against
+                    planner.observe_ratio(step.op, step.key_n, rows)
+                    planner.observe_estimate(step.op, step.est_rows,
+                                             rows)
+                else:
+                    planner.observe_step(step, rows, dt)
             if prof is not None:
                 # bytes this stage pushed through sharded exchanges;
                 # when nonzero, the current shard/skew/* gauges were
@@ -438,7 +487,7 @@ class SQLSession:
                 prof.append((op, detail, rows, dt, int(a2a),
                              float(skew),
                              step.est_rows if step is not None else -1,
-                             format_device_ms(delta)))
+                             format_device_ms(delta), gid))
             if metrics.enabled:
                 metrics.observe(f"sql/{op}_s", dt)
             return res
@@ -463,19 +512,58 @@ class SQLSession:
         if not gen_items and prof is not None:
             prof.pop()            # no generator ran; drop the stub row
         if q.where is not None:
+            g_f = fplan.group_with("filter") if fplan is not None \
+                else None
+
             def _filter():
+                if g_f is not None and not fstate["bailed"]:
+                    r = _try_group(g_f, env)
+                    if r is not None:
+                        # terminal output already computed on device;
+                        # the filtered env is only materialised when a
+                        # later stage still needs per-row host columns
+                        # (ORDER BY against a projected query)
+                        if g_f.terminal == "project" and q.order_by:
+                            return self._take_env(
+                                env, np.flatnonzero(r.mask))
+                        return env
                 n = self._env_len(env)
                 mask = _as_mask(self._eval(q.where, env), n)
                 return self._take_env(env, np.flatnonzero(mask))
-            env = stage("filter", "WHERE", _filter, self._env_len)
+
+            def _filter_rows(renv):
+                r = fstate["res"]
+                return r.rows_filter if r is not None \
+                    else self._env_len(renv)
+
+            env = stage("filter", "WHERE", _filter, _filter_rows,
+                        fused_of=lambda: _fused_gid("filter"))
         if q.group_by is not None or self._has_aggregate(q.items):
+            g_a = fplan.group_with("aggregate") if fplan is not None \
+                else None
+
+            def _agg():
+                r = fstate["res"]
+                if r is None and g_a is not None and \
+                        not fstate["bailed"]:
+                    # [aggregate]-only group (WHERE absent or unfused):
+                    # runs here against the already-filtered env
+                    r = _try_group(g_a, env)
+                return r.out if r is not None \
+                    else self._aggregate(q, env, gen_items)
+
             out = stage("aggregate",
                         f"{len(q.group_by or [])} group keys",
-                        lambda: self._aggregate(q, env, gen_items), len)
+                        _agg, len,
+                        fused_of=lambda: _fused_gid("aggregate"))
         else:
-            out = stage("project", f"{len(q.items)} items",
-                        lambda: self._project(q.items, env, gen_items),
-                        len)
+            def _proj():
+                r = fstate["res"]
+                return r.out if r is not None \
+                    else self._project(q.items, env, gen_items)
+
+            out = stage("project", f"{len(q.items)} items", _proj, len,
+                        fused_of=lambda: _fused_gid("project"))
         if q.order_by:
             def _order():
                 grouped = q.group_by is not None or \

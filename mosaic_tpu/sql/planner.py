@@ -64,6 +64,7 @@ FORCE_CHOICES = {
     "equi_join": ("auto", "loop", "vectorized"),
     "knn": ("auto", "brute", "ring"),
     "pip_join": ("auto", "monolithic", "streamed", "sharded"),
+    "fusion": ("auto", "on", "off"),
 }
 
 #: EWMA weight of the newest observation in the coefficient store
@@ -74,6 +75,10 @@ _STORE_CAP = 1024
 #: vectorized sort-join's fixed overhead (cold-start crossover; the
 #: learned per-size-class coefficients override it once calibrated)
 _JOIN_VECTOR_CROSSOVER = 4096
+#: below this input row count a fused group's dispatch+fetch overhead
+#: beats the saved host round-trips (cold-start crossover; learned
+#: fused-vs-unfused coefficients override it once calibrated)
+_FUSION_CROSSOVER = 1024
 
 
 @dataclasses.dataclass
@@ -115,6 +120,9 @@ class QueryPlan:
 
     def __init__(self):
         self.steps: "OrderedDict[str, PlanStep]" = OrderedDict()
+        #: the fusion pass's :class:`~...perf.fusion.FusionPlan` (None
+        #: when fusion is off, ineligible, or decided against)
+        self.fusion = None
 
     def add(self, step: PlanStep) -> PlanStep:
         self.steps[step.op] = step
@@ -352,6 +360,39 @@ class Planner:
         d.chunk = chunk           # dynamic attr: the chosen chunk rows
         return self.record_decision(d)
 
+    def decide_fusion(self, opset: str, member_ops: List[str],
+                      n: int) -> Decision:
+        """Fused whole-group XLA program vs. per-operator dispatch
+        (bit-identical either way — ``perf.fusion`` only admits ops
+        whose fused evaluation provably matches the host path).
+
+        Learned comparison: the group's ``fusion/<opset>`` coefficient
+        (fed by every fused execution) against the SUM of the member
+        operators' unfused coefficients (fed by every unfused run of
+        the same stages) at this size class; static row-count
+        crossover while either side is cold."""
+        forced = self.force_for("fusion")
+        if forced != "auto":
+            s = "fused" if forced == "on" else "unfused"
+            return self.record_decision(Decision(
+                "fusion", s, "forced by conf", n,
+                cost_key=f"fusion/{opset}", key_n=n, forced=True))
+        c_f = self.est_cost_ms(f"fusion/{opset}", n)
+        mcosts = [self.est_cost_ms(op, n) for op in member_ops]
+        c_u = sum(mcosts) if all(c is not None for c in mcosts) \
+            else None
+        if c_f is not None and c_u is not None:
+            s = "fused" if c_f <= c_u else "unfused"
+            why = (f"learned fused {c_f:.3g}ms vs unfused "
+                   f"{c_u:.3g}ms at {_fmt_rows(n)} rows")
+        else:
+            s = "fused" if n >= _FUSION_CROSSOVER else "unfused"
+            why = (f"{_fmt_rows(n)} rows "
+                   f"{'>=' if s == 'fused' else '<'} "
+                   f"{_FUSION_CROSSOVER} crossover (cold)")
+        return self.record_decision(Decision(
+            "fusion", s, why, n, cost_key=f"fusion/{opset}", key_n=n))
+
     # ----------------------------------------------------- SQL pre-pass
 
     def plan_query(self, q, session) -> Optional[QueryPlan]:
@@ -445,6 +486,18 @@ class Planner:
             plan.add(PlanStep("limit", rows, "limit",
                               f"{_fmt_rows(rows)} rows (exact cap)",
                               key_n=key_n))
+        # fusion pass: walk the finished plan and group adjacent
+        # eligible operators into whole-group XLA programs (gated per
+        # size class by decide_fusion).  Degrade-not-die: a fusion
+        # planning fault leaves the query on the unfused path.
+        try:
+            from ..perf.fusion import plan_fusion
+            plan.fusion = plan_fusion(q, session, plan)
+        except Exception as e:
+            recorder.record("fusion_plan_error",
+                            error=f"{type(e).__name__}: {e}")
+            if metrics.enabled:
+                metrics.count("fusion/plan_errors")
         return plan
 
     # -------------------------------------------------------- feedback
@@ -463,6 +516,18 @@ class Planner:
             self.observations += 1
         if metrics.enabled:
             metrics.observe(f"planner/op_ms/{op}", wall_s)
+        self._maybe_autosave()
+
+    def observe_ratio(self, op: str, n: int, rows_out: int) -> None:
+        """Cardinality-only feedback: keep an operator's selectivity /
+        fanout ratio learning WITHOUT touching its cost coefficient.
+        Fused stages use this — their wall time belongs to the group's
+        ``fusion/<opset>`` key, and feeding it to the member op would
+        poison the unfused cost the fusion gate compares against."""
+        n = max(int(n), 1)
+        with self._lock:
+            self._put(self._ratio, (op, _bucket(n)), rows_out / n)
+            self.observations += 1
         self._maybe_autosave()
 
     def observe_estimate(self, op: str, est_rows: int,

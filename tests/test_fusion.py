@@ -1,0 +1,356 @@
+"""Whole-query fusion tests (perf/fusion.py).
+
+Fusion is a pure strategy transform: adjacent eligible operators
+compile into ONE jitted XLA program, and the answer must be bit for
+bit what the unfused path produces — these tests assert group
+formation, every eligibility break, the parity contract (including
+NaN and composite-expression cases), one-compile-per-(group, bucket),
+the planner's learned flip, the forced/disabled pins, ledger
+attribution and the EXPLAIN surface.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.obs import metrics, recorder
+from mosaic_tpu.obs.profiler import ledger
+from mosaic_tpu.perf.jit_cache import kernel_cache
+from mosaic_tpu.sql import SQLSession
+from mosaic_tpu.sql.planner import planner
+
+
+@pytest.fixture(scope="module")
+def mc():
+    return MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)")
+
+
+@pytest.fixture(scope="module")
+def session(mc):
+    s = SQLSession(mc)
+    rng = np.random.default_rng(42)
+    n = 4000
+    px = rng.normal(size=n)
+    px[::53] = np.nan                     # NaN rows ride along
+    s.create_table("fx", {
+        "px": px,
+        "py": rng.normal(size=n),
+        "k": rng.integers(0, 100, size=n),
+        "b32": rng.integers(0, 9, size=n).astype(np.int32),
+        "flag": rng.integers(0, 2, size=n).astype(bool),
+        "tag": np.array(["a", "b"] * (n // 2))})
+    return s
+
+
+@pytest.fixture()
+def pin():
+    """Force-pin the fusion decision for one test; restore auto."""
+    prev = _config.default_config()
+
+    def _pin(mode):
+        _config.set_default_config(_config.apply_conf(
+            _config.default_config(),
+            "mosaic.planner.force.fusion", mode))
+
+    yield _pin
+    _config.set_default_config(prev)
+
+
+def _ab(session, pin, q):
+    """Run ``q`` fused and unfused; return both result tables."""
+    pin("on")
+    fused = session.sql(q)
+    pin("off")
+    unfused = session.sql(q)
+    pin("auto")
+    return fused, unfused
+
+
+def _assert_identical(a, b):
+    assert list(a.columns) == list(b.columns)
+    for col in a.columns:
+        x, y = np.asarray(a.columns[col]), np.asarray(b.columns[col])
+        assert x.dtype == y.dtype, (col, x.dtype, y.dtype)
+        nan_ok = np.issubdtype(x.dtype, np.floating)
+        assert np.array_equal(x, y, equal_nan=nan_ok), col
+
+
+def _fused_ops(session, q):
+    plan = session.sql("EXPLAIN " + q)
+    return {o: f for o, f in zip(plan.columns["operator"],
+                                 plan.columns["fused"])}
+
+
+# ------------------------------------------------- group formation
+
+def test_group_covers_filter_and_aggregate(session, pin):
+    pin("on")
+    fused = _fused_ops(session, "SELECT count(*) AS n, max(px) AS mx "
+                                "FROM fx WHERE py > 0.0 AND k < 50")
+    assert fused["filter"] == fused["aggregate"] == "g1"
+    assert fused["scan"] == "-"
+
+
+def test_group_covers_filter_and_project(session, pin):
+    pin("on")
+    fused = _fused_ops(session, "SELECT px + py AS s FROM fx "
+                                "WHERE k < 50")
+    assert fused["filter"] == fused["project"] == "g1"
+
+
+def test_lone_aggregate_still_fuses(session, pin):
+    # a single aggregate beats a compile: its unfused fallback is a
+    # per-row python loop, so MIN_GROUP_OPS exempts it
+    pin("on")
+    fused = _fused_ops(session, "SELECT sum(k) AS s, count(*) AS n "
+                                "FROM fx")
+    assert fused["aggregate"] == "g1"
+
+
+def test_lone_filter_does_not_fuse(session, pin):
+    # [filter] alone is below MIN_GROUP_OPS when the terminal is
+    # ineligible (Star expansion breaks the project member)
+    pin("on")
+    fused = _fused_ops(session, "SELECT * FROM fx WHERE k < 50")
+    assert set(fused.values()) == {"-"}
+
+
+# ------------------------------------------------- eligibility breaks
+
+@pytest.mark.parametrize("q,expect", [
+    # object/string column in the predicate -> the filter is host-only,
+    # but the count(*) terminal still fuses alone (lone-agg exemption)
+    ("SELECT count(*) AS n FROM fx WHERE tag = 'a' AND k < 50",
+     {"filter": "-", "aggregate": "g1"}),
+    # GROUP BY aggregation is host-side; the lone filter is then dropped
+    ("SELECT k, count(*) AS n FROM fx WHERE py > 0.0 GROUP BY k",
+     {"filter": "-", "aggregate": "-"}),
+    # string projection breaks the terminal; lone filter dropped too
+    ("SELECT tag AS t FROM fx WHERE k < 50",
+     {"filter": "-", "project": "-"}),
+    # mixed concrete dtypes promote differently (i32 + i64)
+    ("SELECT count(*) AS n FROM fx WHERE b32 + k > 10",
+     {"filter": "-", "aggregate": "g1"}),
+    # % differs between numpy and XLA for negative operands
+    ("SELECT count(*) AS n FROM fx WHERE k % 7 = 0",
+     {"filter": "-", "aggregate": "g1"}),
+    # float sums are reduction-order dependent; lone filter dropped
+    ("SELECT sum(px) AS s FROM fx WHERE k < 50",
+     {"filter": "-", "aggregate": "-"}),
+])
+def test_eligibility_breaks(session, pin, q, expect):
+    pin("on")
+    fused = _fused_ops(session, q)
+    for op, want in expect.items():
+        assert fused[op] == want, (q, fused)
+    # and the ineligible query still answers identically either way
+    a, b = _ab(session, pin, q)
+    _assert_identical(a, b)
+
+
+# ------------------------------------------------- bit-for-bit parity
+
+@pytest.mark.parametrize("q", [
+    # flagship reference shape: composite predicate + mixed aggregates
+    "SELECT count(*) AS n, max(px) AS mx, min(py) AS mn, sum(k) AS sk"
+    " FROM fx WHERE px*px + py*py < 1.44 AND px > 0.1",
+    # NaN-aware: count(col) skips NaN, min/max ignore NaN rows
+    "SELECT count(px) AS c, max(px) AS mx, avg(k) AS ak FROM fx "
+    "WHERE py > 0.0",
+    # projection chain with literals, division (int/int -> f64),
+    # unary minus and OR
+    "SELECT -px AS np_, (k + 1) / 2 AS h, px * 0.5 + py AS m FROM fx "
+    "WHERE flag OR py > 1.0",
+    # IS [NOT] NULL against the NaN-bearing column
+    "SELECT count(*) AS n FROM fx WHERE px IS NULL OR k < 5",
+    "SELECT count(*) AS n, first(k) AS f FROM fx "
+    "WHERE px IS NOT NULL AND py < 0.0",
+    # bool column straight through the mask path
+    "SELECT count(*) AS n FROM fx WHERE not flag",
+    # int32 column alone (no mixing) is eligible
+    "SELECT min(b32) AS mn, max(b32) AS mx FROM fx WHERE b32 > 2",
+    # ORDER BY + LIMIT after a fused filter+project group
+    "SELECT px + py AS s FROM fx WHERE k < 30 ORDER BY s LIMIT 11",
+])
+def test_bit_parity_fused_vs_unfused(session, pin, q):
+    a, b = _ab(session, pin, q)
+    _assert_identical(a, b)
+
+
+def test_empty_table_bails_out_identically(mc, pin):
+    s = SQLSession(mc)
+    s.create_table("empty0", {"x": np.zeros(0), "k": np.zeros(0, np.int64)})
+    a, b = _ab(s, pin, "SELECT count(*) AS n, max(x) AS mx "
+                       "FROM empty0 WHERE k < 5")
+    _assert_identical(a, b)
+
+
+# ------------------------------------------------- runtime bailouts
+
+def test_sum_exactness_bound_bails_out(mc, pin):
+    # n * max|v| >= 2**53: the int64 device sum can no longer be
+    # proven equal to the unfused float64 accumulation -> fall back
+    s = SQLSession(mc)
+    s.create_table("big", {
+        "v": np.full(64, 2 ** 50, dtype=np.int64)})
+    was = metrics.enabled
+    metrics.enable()
+    b0 = metrics.counter_value("fusion/bailouts")
+    a, b = _ab(s, pin, "SELECT sum(v) AS s, count(*) AS n FROM big")
+    b1 = metrics.counter_value("fusion/bailouts")
+    if not was:
+        metrics.disable()
+    _assert_identical(a, b)
+    assert b1 - b0 >= 1
+    assert any("2**53" in e["reason"]
+               for e in recorder.events("fusion_bailout"))
+
+
+def test_left_join_null_conversion_bails_out(mc, pin):
+    # the catalog pre-pass saw an int64 column; the LEFT JOIN turned
+    # it into a python list with Nones -> runtime re-check bails, the
+    # unfused path answers, results identical
+    s = SQLSession(mc)
+    s.create_table("lj_l", {"k": np.arange(10, dtype=np.int64),
+                            "px": np.linspace(-1, 1, 10)})
+    s.create_table("lj_r", {"k": np.arange(5, dtype=np.int64),
+                            "w": np.arange(5, dtype=np.int64) * 10})
+    q = ("SELECT count(*) AS n, max(w) AS mw FROM lj_l "
+         "LEFT JOIN lj_r ON lj_l.k = lj_r.k WHERE px > -0.5")
+    a, b = _ab(s, pin, q)
+    _assert_identical(a, b)
+    assert any("at runtime" in e["reason"]
+               for e in recorder.events("fusion_bailout"))
+
+
+# ------------------------------------------------- compile accounting
+
+def test_one_compile_per_group_and_bucket(mc, pin):
+    s = SQLSession(mc)
+    rng = np.random.default_rng(5)
+
+    def make(n):
+        s.create_table("cb", {"x": rng.normal(size=n),
+                              "c": rng.integers(0, 7, size=n)})
+
+    q = "SELECT count(*) AS n, max(x) AS mx FROM cb WHERE c < 3"
+    pin("on")
+    make(100)                                     # bucket 128
+    st0 = kernel_cache.stats()
+    s.sql(q)
+    st1 = kernel_cache.stats()
+    assert st1["misses"] - st0["misses"] == 1     # the one compile
+    s.sql(q)                                      # warm: same bucket
+    make(100)                                     # new data, same shape
+    s.sql(q)
+    st2 = kernel_cache.stats()
+    assert st2["misses"] - st1["misses"] == 0
+    assert st2["hits"] - st1["hits"] == 2
+    make(1000)                                    # bucket 1024
+    s.sql(q)
+    st3 = kernel_cache.stats()
+    assert st3["misses"] - st2["misses"] == 1     # one per size class
+    pin("auto")
+
+
+def test_ledger_attribution_for_fused_kernels(mc, pin):
+    s = SQLSession(mc)
+    s.create_table("lg", {"x": np.linspace(0, 1, 300),
+                          "c": np.arange(300, dtype=np.int64)})
+    pin("on")
+    s.sql("SELECT count(*) AS n, min(x) AS mn FROM lg WHERE c > 10")
+    pin("auto")
+    rows = [k for k in ledger.report()["kernels"]
+            if k["name"].startswith("fused:filter+aggregate:")]
+    assert rows, "fused launch missing from the kernel ledger"
+    assert any(k["launches"] >= 1 and k["seconds"] >= 0.0
+               and k["rows"] >= 300 for k in rows)
+
+
+# ------------------------------------------------- planner decision
+
+def test_learned_flip_and_cold_crossover(mc):
+    from mosaic_tpu.sql.planner import _FUSION_CROSSOVER
+    planner.reset()          # earlier tests in this process train it
+    try:
+        n = 2048
+        opset, members = "filter+aggregate", ["filter", "aggregate"]
+        # cold: static crossover decides
+        d = planner.decide_fusion(opset, members, n)
+        assert d.strategy == "fused" and "cold" in d.reason
+        d = planner.decide_fusion(opset, members,
+                                  _FUSION_CROSSOVER - 1)
+        assert d.strategy == "unfused"
+        # teach it: fused slow, members fast -> learned flip to unfused
+        for _ in range(12):
+            planner.observe_op(f"fusion/{opset}", n, 0.10)
+            planner.observe_op("filter", n, 0.001)
+            planner.observe_op("aggregate", n, 0.001)
+        d = planner.decide_fusion(opset, members, n)
+        assert d.strategy == "unfused" and "learned" in d.reason
+        # re-teach: fused cheap again -> flips back
+        for _ in range(40):
+            planner.observe_op(f"fusion/{opset}", n, 0.0001)
+        d = planner.decide_fusion(opset, members, n)
+        assert d.strategy == "fused" and "learned" in d.reason
+    finally:
+        planner.reset()
+
+
+def test_forced_pins_and_kill_switch(session, pin):
+    q = "SELECT count(*) AS n FROM fx WHERE k < 50 AND py > 0.0"
+    pin("off")
+    assert set(_fused_ops(session, q).values()) == {"-"}
+    pin("on")
+    assert _fused_ops(session, q)["filter"] == "g1"
+    # mosaic.fusion.enabled=false beats even a forced-on pin: the
+    # fusion pass never runs, so there is nothing to force
+    prev = _config.default_config()
+    _config.set_default_config(_config.apply_conf(
+        prev, "mosaic.fusion.enabled", "false"))
+    try:
+        assert set(_fused_ops(session, q).values()) == {"-"}
+    finally:
+        _config.set_default_config(prev)
+
+
+def test_max_ops_truncates_from_the_front(session, pin):
+    # group-size cap 1: the terminal survives, earlier members unfuse
+    prev = _config.default_config()
+    _config.set_default_config(_config.apply_conf(
+        prev, "mosaic.fusion.max.ops", "1"))
+    try:
+        pin("on")
+        fused = _fused_ops(session,
+                           "SELECT count(*) AS n, max(px) AS mx "
+                           "FROM fx WHERE k < 50")
+        assert fused["filter"] == "-"
+        assert fused["aggregate"] == "g1"
+    finally:
+        _config.set_default_config(prev)
+
+
+# ------------------------------------------------- config validation
+
+@pytest.mark.parametrize("key,bad", [
+    ("mosaic.fusion.enabled", "maybe"),
+    ("mosaic.fusion.max.ops", "zero"),
+    ("mosaic.fusion.max.ops", "-3"),
+    ("mosaic.planner.force.fusion", "sideways"),
+])
+def test_config_validation_names_the_key(key, bad):
+    with pytest.raises(_config.ConfigError) as ei:
+        _config.apply_conf(_config.default_config(), key, bad)
+    assert key in str(ei.value)
+
+
+def test_config_keys_accept_valid_values():
+    cfg = _config.apply_conf(_config.default_config(),
+                             "mosaic.fusion.enabled", "false")
+    assert cfg.fusion_enabled is False
+    cfg = _config.apply_conf(cfg, "mosaic.fusion.max.ops", "4")
+    assert cfg.fusion_max_ops == 4
+    for mode in ("on", "off", "auto"):
+        _config.apply_conf(_config.default_config(),
+                           "mosaic.planner.force.fusion", mode)

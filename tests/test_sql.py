@@ -344,6 +344,10 @@ def test_having_edge_cases(session):
 
 
 def test_explain_and_explain_analyze(session):
+    # cold planner: the fused-column asserts below rely on the static
+    # crossover, not coefficients trained by earlier tests
+    from mosaic_tpu.sql.planner import planner
+    planner.reset()
     session.create_table("ea", {
         "k": np.array([1, 2, 3, 4], np.int64),
         "v": np.array([1.0, 2.0, 3.0, 4.0])})
@@ -387,6 +391,48 @@ def test_explain_and_explain_analyze(session):
     # charged busy time to a device during these host-only stages
     assert list(agg.columns["device_ms"]) == ["-", "-"]
     assert len(out.columns["device_ms"]) == len(ops)
+    # fused column: group id or "-".  A 4-row table sits far below the
+    # fusion crossover (and GROUP BY is statically ineligible), so
+    # every operator here dispatches alone
+    assert list(plan.columns["fused"]) == ["-", "-", "-"]
+    assert list(out.columns["fused"]) == ["-"] * len(ops)
+    assert list(agg.columns["fused"]) == ["-", "-"]
+
+
+def test_explain_fused_column(session):
+    """EXPLAIN/EXPLAIN ANALYZE surface the fusion group id on every
+    member operator once the query clears the fusion crossover."""
+    from mosaic_tpu import config as _config
+    rng = np.random.default_rng(7)
+    n = 4096
+    session.create_table("eaf", {
+        "a": rng.normal(size=n),
+        "b": rng.integers(0, 9, size=n)})
+    q = ("SELECT count(*) AS n, max(a) AS mx FROM eaf "
+         "WHERE a > 0.0 AND b < 5")
+    # pin fused on: the planner singleton's learned coefficients are
+    # process-global, so the auto decision depends on test order
+    prev = _config.default_config()
+    _config.set_default_config(_config.apply_conf(
+        prev, "mosaic.planner.force.fusion", "on"))
+    try:
+        plan = session.sql("EXPLAIN " + q)
+        fused = dict(zip(plan.columns["operator"],
+                         plan.columns["fused"]))
+        assert fused["filter"] == fused["aggregate"] == "g1"
+        assert fused["scan"] == "-"
+        out = session.sql("EXPLAIN ANALYZE " + q)
+        fused = dict(zip(out.columns["operator"],
+                         out.columns["fused"]))
+        assert fused["filter"] == fused["aggregate"] == "g1"
+        # the group's wall time rolls up on its FIRST member's row;
+        # the later member just unpacks the already-fetched result
+        times = dict(zip(out.columns["operator"],
+                         out.columns["time_ms"].tolist()))
+        assert times["aggregate"] <= times["filter"]
+    finally:
+        _config.set_default_config(prev)
+        session.drop_table("eaf")
 
 
 def test_explain_analyze_sharded_columns(session, mc):
