@@ -325,6 +325,7 @@ def main():
     # profile-smoke lane's sampler-on/off A/B).  The kernel ledger is
     # always on regardless.
     from mosaic_tpu.obs import start_profiler
+    from mosaic_tpu.obs.memwatch import memwatch as _memwatch
     from mosaic_tpu.obs.profiler import ledger as _ledger
     from mosaic_tpu.obs.profiler import profiler as _profiler
     _env_hz = os.environ.get("MOSAIC_TPU_PROFILE_HZ")
@@ -482,6 +483,7 @@ def main():
     # timed loop's kernel attribution is clean; re-attach the XLA cost
     # figures under the streamed kernel's ledger name
     _ledger.reset()
+    _memwatch.reset()   # flagship footprint measured from a clean ledger
     if xla_cost:
         _ledger.record_cost("pip/streamed", xla_cost)
     e2e_times, unc_total = [], 0
@@ -498,6 +500,16 @@ def main():
         sum(e2e_times), 1e-9)
     log(f"kernel ledger: {flagship_attr:.3f} of streamed wall time "
         f"attributed to pip/streamed launches")
+    # device-memory ledger: peak live device bytes the streamed
+    # flagship held (staged chunks + kernel outputs), per input row —
+    # bounded by the in-flight window, so it must NOT scale with n
+    _flag_snap = _memwatch.snapshot()
+    flagship_peak_bytes = sum(d["peak_bytes"]
+                              for d in _flag_snap["devices"].values())
+    if _memwatch.enabled:
+        log(f"device memory: flagship peak {flagship_peak_bytes} B "
+            f"live ({flagship_peak_bytes / max(n, 1):.1f} B/row), "
+            f"live now {_memwatch.total_live()} B")
     sample_memory(jax.devices())    # mem/peak_bytes/* gauges
     dt_dev = float(np.median(dev_times))
     dt = float(np.median(e2e_times))
@@ -841,6 +853,29 @@ def main():
             "queries": _rep.get(p, {}).get("queries", 0)}
             for p in tenants},
     }
+
+    # device-memory plane: per-device peaks from the live-buffer
+    # ledger + the flagship footprint per row; a leak here is a bench
+    # bug (every stage completes), so zero is asserted — the mem-smoke
+    # lane A/Bs this block against a MOSAIC_TPU_MEMWATCH=0 run
+    _mem_snap = _memwatch.snapshot()
+    record["memory"] = {
+        "enabled": _memwatch.enabled,
+        "device_peak_bytes": {d: v["peak_bytes"] for d, v
+                              in _mem_snap["devices"].items()},
+        "flagship_peak_bytes": int(flagship_peak_bytes),
+        "flagship_peak_bytes_per_row": round(
+            flagship_peak_bytes / max(n, 1), 2),
+        "live_bytes_end": _mem_snap["totals"]["live_bytes"],
+        "leaks": _mem_snap["totals"]["leaks"],
+        "chunk_shrinks": int(obs_rep.get("counters", {})
+                             .get("mem/chunk_shrink", 0)),
+    }
+    if _memwatch.enabled:
+        assert record["memory"]["leaks"] == 0, \
+            f"bench leaked device buffers: {_mem_snap['leaks']}"
+        assert record["memory"]["live_bytes_end"] == 0, \
+            f"live bytes did not drain: {_mem_snap['totals']}"
 
     if smoke:
         record["metrics"] = {

@@ -107,6 +107,15 @@ MOSAIC_FUSION_MAX_OPS = "mosaic.fusion.max.ops"
 MOSAIC_PRINCIPAL = "mosaic.principal"
 MOSAIC_QUERY_DEADLINE_MS = "mosaic.query.deadline.ms"
 MOSAIC_AUDIT_PATH = "mosaic.audit.path"
+# Device-memory plane (obs/memwatch.py): a process budget in bytes for
+# live device buffers (0 = unlimited; also the pressure denominator
+# when smaller than the device capacity), the pressure fraction past
+# which the streaming executor halves chunk rows (degrade-not-die),
+# and the ledger master switch (default on; env MOSAIC_TPU_MEMWATCH=0
+# pins it off for the bench overhead A/B).
+MOSAIC_MEM_BUDGET_BYTES = "mosaic.mem.budget.bytes"
+MOSAIC_MEM_PRESSURE_HIGH = "mosaic.mem.pressure.high"
+MOSAIC_OBS_MEM_ENABLED = "mosaic.obs.mem.enabled"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -205,6 +214,15 @@ class MosaicConfig:
     # JSONL audit-spool path for query completion records; "" keeps
     # the audit log in-memory only (bounded ring).
     audit_path: str = ""
+    # Live device-memory budget in bytes (obs/memwatch.py); 0 = no
+    # budget (pressure is measured against device capacity only).
+    mem_budget_bytes: int = 0
+    # Fraction of the effective capacity past which the streamed
+    # executor halves its next chunk (mem/chunk_shrink counter).
+    mem_pressure_high: float = 0.85
+    # Device-memory ledger master switch (register/release tracking,
+    # per-query attribution, leak sentinel).
+    obs_mem_enabled: bool = True
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -282,6 +300,28 @@ def _as_hz(key: str, value) -> float:
     return hz
 
 
+def _as_bytes(key: str, value) -> int:
+    try:
+        n = int(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not a byte count") from None
+    if n < 0:
+        raise ConfigError(f"{key}={n} must be >= 0 (0 = unlimited)")
+    return n
+
+
+def _as_fraction(key: str, value) -> float:
+    try:
+        f = float(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not a fraction") from None
+    if not 0.0 < f <= 1.0:
+        raise ConfigError(f"{key}={f} must be in (0, 1]")
+    return f
+
+
 def _as_str(key: str, value) -> str:
     return str(value)
 
@@ -332,6 +372,9 @@ _CONF_FIELDS = {
     MOSAIC_PRINCIPAL: ("principal", _as_str),
     MOSAIC_QUERY_DEADLINE_MS: ("query_deadline_ms", _as_millis),
     MOSAIC_AUDIT_PATH: ("audit_path", _as_str),
+    MOSAIC_MEM_BUDGET_BYTES: ("mem_budget_bytes", _as_bytes),
+    MOSAIC_MEM_PRESSURE_HIGH: ("mem_pressure_high", _as_fraction),
+    MOSAIC_OBS_MEM_ENABLED: ("obs_mem_enabled", _as_flag),
 }
 
 

@@ -49,6 +49,12 @@ shim).  Twelve parts:
   + auto-registered per-principal SLOs), the bounded query audit log
   (ring + ``mosaic.audit.path`` JSONL spool), and the
   ``accounted()`` context manager for non-SQL workloads.
+* ``obs.memwatch`` — the device-memory plane: the live-buffer
+  :class:`DeviceMemoryLedger` (per-(site, trace, device) bytes,
+  ``mem/live_bytes`` / ``mem/pressure`` gauges, per-query peak
+  joined into the ticket cost vector), the leak sentinel fired at
+  query completion, and the :class:`MemoryBudget` driving the
+  streaming executor's pressure-adaptive chunk halving.
 
 The tracer and registry are disabled by default and cost one attribute
 check per instrumented site until enabled via ``MOSAIC_TPU_TRACE=1`` /
@@ -76,6 +82,8 @@ from .inflight import (InflightRegistry, QueryCancelled, QueryTicket,
 from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
                      last_watermarks, record_cost_analysis,
                      sample_memory)
+from .memwatch import (DeviceMemoryLedger, MemoryBudget, device_keys_of,
+                       mem_budget, memwatch)
 from .metrics import Histogram, MetricsRegistry, metrics
 from .openmetrics import ServerHandle, serve_metrics, to_openmetrics
 from .profiler import (HostProfiler, KernelLedger, capture_snapshot,
@@ -114,6 +122,8 @@ __all__ = [
     "checkpoint",
     "AuditLog", "PrincipalMeter", "accounted", "audit", "complete",
     "meter",
+    "DeviceMemoryLedger", "MemoryBudget", "memwatch", "mem_budget",
+    "device_keys_of",
     "configure",
 ]
 

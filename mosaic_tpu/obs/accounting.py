@@ -53,7 +53,8 @@ __all__ = ["PrincipalMeter", "AuditLog", "meter", "audit",
 
 #: cost-vector fields the meter accumulates per principal
 _METER_FIELDS = ("queries", "wall_ms", "device_s", "rows_in",
-                 "rows_out", "h2d_bytes", "compiles")
+                 "rows_out", "h2d_bytes", "d2h_bytes",
+                 "mem_peak_bytes", "compiles")
 
 
 class PrincipalMeter:
@@ -91,6 +92,10 @@ class PrincipalMeter:
                           float(cost.get("rows_out", 0.0)))
             metrics.count(f"principal/h2d_bytes/{principal}",
                           float(cost.get("h2d_bytes", 0.0)))
+            metrics.count(f"principal/d2h_bytes/{principal}",
+                          float(cost.get("d2h_bytes", 0.0)))
+            metrics.count(f"principal/mem_peak_bytes/{principal}",
+                          float(cost.get("mem_peak_bytes", 0.0)))
             metrics.count(f"principal/compiles/{principal}",
                           float(cost.get("compiles", 0.0)))
             if outcome != "ok":
@@ -116,6 +121,8 @@ class PrincipalMeter:
             return {
                 p: dict({f: (int(v) if f in ("queries", "rows_in",
                                              "rows_out", "h2d_bytes",
+                                             "d2h_bytes",
+                                             "mem_peak_bytes",
                                              "compiles")
                              else round(v, 6))
                          for f, v in tot.items()},
@@ -208,6 +215,14 @@ def complete(ticket: Optional[QueryTicket], outcome: str = "ok",
         return None
     if wall_ms is None:
         wall_ms = ticket.wall_ms
+    try:
+        # leak sentinel first: finalizes the ticket's mem peak and
+        # force-releases (+ flight-records) any buffer still registered
+        # to this query's trace, BEFORE the cost vector is built
+        from .memwatch import memwatch
+        memwatch.on_query_complete(ticket)
+    except Exception:
+        pass
     compiles = int(max(0.0, metrics.counter_value("jax/recompiles")
                        - ticket.compiles0))
     cost = {
@@ -216,6 +231,8 @@ def complete(ticket: Optional[QueryTicket], outcome: str = "ok",
         "rows_in": int(ticket.rows_in),
         "rows_out": int(ticket.rows),
         "h2d_bytes": int(ticket.h2d_bytes),
+        "d2h_bytes": int(ticket.d2h_bytes),
+        "mem_peak_bytes": int(ticket.mem_peak_bytes),
         "compiles": compiles,
     }
     record: Dict[str, object] = {
@@ -240,6 +257,8 @@ def complete(ticket: Optional[QueryTicket], outcome: str = "ok",
                   "rows_in": float(cost["rows_in"]),
                   "rows_out": float(cost["rows_out"]),
                   "h2d_bytes": float(cost["h2d_bytes"]),
+                  "d2h_bytes": float(cost["d2h_bytes"]),
+                  "mem_peak_bytes": float(cost["mem_peak_bytes"]),
                   "compiles": compiles},
                  outcome=outcome)
     return record

@@ -17,6 +17,10 @@ Routes:
 * ``/api/traces``       — recent completed trace trees (tracer on)
 * ``/api/planner``      — planner decisions/coefficients report
 * ``/api/devices``      — per-device attribution (``obs.devicemon``)
+* ``/api/memory``       — the device-memory ledger snapshot
+  (``obs.memwatch``): per-device live/peak/capacity/pressure, top
+  live holders by (site, trace, device), recent leaks, budget state
+* ``/memory``           — the memory page over ``/api/memory``
 * ``/api/profile``      — profiler snapshot: host stacks (``?trace=``
   filters to one trace context), kernel ledger, collapsed text
 * ``/profile``          — the flamegraph view over ``/api/profile``
@@ -140,6 +144,22 @@ def _devices_payload() -> Dict[str, object]:
     return devicemon.report()
 
 
+def _memory_payload() -> Dict[str, object]:
+    from .memwatch import mem_budget, memwatch
+    snap = memwatch.snapshot()
+    snap["budget"] = {"budget_bytes": mem_budget.budget_bytes(),
+                      "pressure_high": mem_budget.pressure_high()}
+    if metrics.enabled:
+        rep = metrics.report()
+        snap["counters"] = {
+            "chunk_shrink": rep["counters"].get("mem/chunk_shrink", 0.0),
+            "admit_denied": rep["counters"].get("mem/admit_denied", 0.0),
+            "release_skipped":
+                rep["counters"].get("mem/release_skipped", 0.0),
+        }
+    return snap
+
+
 def _queries_payload(qs: Dict[str, list]) -> Dict[str, object]:
     from .accounting import audit
     from .inflight import inflight
@@ -195,6 +215,7 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>mosaic_tpu ops dashboard</h1>
 <p><a href="/profile">profiler / flamegraph</a> ·
+ <a href="/memory">memory</a> ·
  <a href="/metrics">openmetrics</a></p>
 <div id="summary">loading…</div>
 <h2>Active alerts</h2><ul id="alerts"><li class="ok">none</li></ul>
@@ -357,6 +378,73 @@ tick();setInterval(tick,3000);
 """
 
 
+# The memory page: per-device live/peak/pressure bars over the
+# /api/memory ledger snapshot, top live holders, and the leak list.
+# Same zero-dependency rules as the other pages.
+_MEMORY_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>mosaic_tpu memory</title>
+<style>
+ body{font:13px/1.5 system-ui,sans-serif;margin:1.5em;max-width:70em}
+ h1{font-size:1.2em} h2{font-size:1em;margin:1.2em 0 .3em}
+ table{border-collapse:collapse} td,th{padding:.15em .7em;
+  border-bottom:1px solid #ddd;text-align:left;font-variant-numeric:
+  tabular-nums}
+ .ok{color:#2a7} .bad{color:#c33;font-weight:600}
+ .bar{display:inline-block;height:.7em;background:#27c;
+  vertical-align:baseline} code{background:#f4f4f4;padding:0 .3em}
+ #meta{color:#666}
+</style></head><body>
+<h1>mosaic_tpu device memory <a href="/" style="font-size:.7em">
+(dashboard)</a></h1>
+<div id="meta">loading…</div>
+<h2>Devices</h2><table id="devs"></table>
+<h2>Top live holders</h2><table id="holders"></table>
+<h2>Site peak attribution</h2><table id="sites"></table>
+<h2>Leaks</h2><table id="leaks"></table>
+<script>
+const $=id=>document.getElementById(id);
+async function j(u){const r=await fetch(u);return r.json()}
+const fmt=b=>b>=1<<30?(b/2**30).toFixed(2)+" GiB":b>=1<<20?
+ (b/2**20).toFixed(2)+" MiB":b>=1024?(b/1024).toFixed(1)+" KiB":b+" B";
+async function tick(){
+ const m=await j("/api/memory");
+ const t=m.totals||{},c=m.counters||{};
+ $("meta").innerHTML=(m.enabled?"ledger on":
+  '<span class="bad">ledger off</span>')+" — live "+
+  fmt(t.live_bytes||0)+" in "+(t.live_buffers||0)+" buffers, "+
+  t.registered+" registered / "+t.released+" released, budget "+
+  (m.budget.budget_bytes?fmt(m.budget.budget_bytes):"unlimited")+
+  ", shrinks "+(c.chunk_shrink||0)+", admit denials "+
+  (c.admit_denied||0)+", leaks "+
+  (t.leaks?'<span class="bad">'+t.leaks+"</span>":"0");
+ $("devs").innerHTML="<tr><th>device</th><th>live</th><th>peak</th>"+
+  "<th>capacity</th><th>pressure</th></tr>"+
+  Object.entries(m.devices).map(([k,v])=>"<tr><td>"+k+"</td><td>"+
+   fmt(v.live_bytes)+"</td><td>"+fmt(v.peak_bytes)+"</td><td>"+
+   fmt(v.capacity_bytes)+'</td><td><span class="bar" style="width:'+
+   Math.min(100,100*v.pressure)+'px"></span> '+
+   (100*v.pressure).toFixed(2)+"%</td></tr>").join("");
+ $("holders").innerHTML="<tr><th>site</th><th>trace</th>"+
+  "<th>device</th><th>bytes</th></tr>"+(m.holders.length?
+  m.holders.map(h=>"<tr><td><code>"+h.site+"</code></td><td>"+
+   (h.trace||"-")+"</td><td>"+h.device+"</td><td>"+fmt(h.bytes)+
+   "</td></tr>").join("")
+  :'<tr><td colspan="4" class="ok">nothing live</td></tr>');
+ $("sites").innerHTML="<tr><th>site</th><th>peak bytes</th></tr>"+
+  Object.entries(m.site_peak_bytes).map(([s,b])=>"<tr><td><code>"+
+   s+"</code></td><td>"+fmt(b)+"</td></tr>").join("");
+ $("leaks").innerHTML="<tr><th>query</th><th>site</th><th>bytes</th>"+
+  "<th>buffers</th></tr>"+(m.leaks.length?m.leaks.map(l=>
+  '<tr class="bad"><td>'+l.query_id+"</td><td><code>"+l.site+
+  "</code></td><td>"+fmt(l.bytes)+"</td><td>"+l.buffers+
+  "</td></tr>").join("")
+  :'<tr><td colspan="4" class="ok">none</td></tr>');
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""
+
+
 def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     ) -> ServerHandle:
     """Start the ops dashboard; returns a stoppable
@@ -410,6 +498,8 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     self._json(_planner_payload())
                 elif path == "/api/devices":
                     self._json(_devices_payload())
+                elif path == "/api/memory":
+                    self._json(_memory_payload())
                 elif path == "/api/profile":
                     self._json(_profile_payload(qs))
                 elif path == "/api/queries":
@@ -424,6 +514,9 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                                extra={"Allow": "POST"})
                 elif path == "/profile":
                     self._send(_PROFILE_PAGE.encode(),
+                               "text/html; charset=utf-8")
+                elif path == "/memory":
+                    self._send(_MEMORY_PAGE.encode(),
                                "text/html; charset=utf-8")
                 elif path.startswith("/api/"):
                     self._api_404(path)

@@ -175,7 +175,12 @@ class DeviceMonitor:
             rows = dict(self._rows)
             ops = {op: dict(d) for op, d in self._op_dev.items()}
         gauges = metrics.report()["gauges"]
-        devs = sorted(set(busy) | set(rows))
+        try:
+            from .memwatch import memwatch
+            live = memwatch.live_by_device() if memwatch.enabled else {}
+        except Exception:
+            live = {}
+        devs = sorted(set(busy) | set(rows) | set(live))
         return {
             "devices": {
                 dev: {
@@ -183,6 +188,11 @@ class DeviceMonitor:
                     "rows": rows.get(dev, 0.0),
                     "util": gauges.get(f"device/util/{dev}", 0.0),
                     "peak_bytes": gauges.get(f"mem/peak_bytes/{dev}"),
+                    # ledger-attributed live bytes + pressure (the
+                    # allocator peak above is the backend's view; this
+                    # is what WE can name a holder for)
+                    "live_bytes": int(live.get(dev, 0)),
+                    "pressure": gauges.get(f"mem/pressure/{dev}", 0.0),
                 } for dev in devs
             },
             "ops": ops,

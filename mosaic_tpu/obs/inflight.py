@@ -44,8 +44,8 @@ from .context import current_trace_id
 
 __all__ = ["QueryCancelled", "QueryTicket", "InflightRegistry",
            "inflight", "checkpoint", "charge_device_seconds",
-           "charge_h2d_bytes", "note_rows", "note_rows_in",
-           "note_strategies"]
+           "charge_h2d_bytes", "charge_d2h_bytes", "note_rows",
+           "note_rows_in", "note_strategies"]
 
 _qids = itertools.count(1)
 
@@ -96,7 +96,10 @@ class QueryTicket:
         self.rows_in = 0             # rows out of the scan/join stage
         self.compiles0 = 0.0         # jax/recompiles at registration
         self.h2d_bytes = 0           # pipeline staging charged here
+        self.d2h_bytes = 0           # pipeline/fusion fetches charged
         self.device_s = 0.0          # kernel-ledger launch seconds
+        self.mem_live_bytes = 0      # memwatch ledger: live right now
+        self.mem_peak_bytes = 0      # memwatch ledger: high-water mark
         self.strategies: Dict[str, str] = {}   # planner picks per op
         self.status = "running"
         self._cancel_reason: Optional[str] = None
@@ -131,6 +134,9 @@ class QueryTicket:
             "device_s": round(self.device_s, 6),
             "rows": int(self.rows),
             "h2d_bytes": int(self.h2d_bytes),
+            "d2h_bytes": int(self.d2h_bytes),
+            "mem_live_bytes": int(self.mem_live_bytes),
+            "mem_peak_bytes": int(self.mem_peak_bytes),
         }
 
     def snapshot(self) -> Dict[str, object]:
@@ -284,6 +290,15 @@ def charge_h2d_bytes(n: int) -> None:
     t = _active_ticket()
     if t is not None:
         t.h2d_bytes += int(n)
+
+
+def charge_d2h_bytes(n: int) -> None:
+    """Charge device->host fetch bytes to the active ticket (pipeline
+    chunk drains and the fused group's one device_get — the same trace
+    join the device-seconds charge uses)."""
+    t = _active_ticket()
+    if t is not None:
+        t.d2h_bytes += int(n)
 
 
 def note_rows(rows: int) -> None:

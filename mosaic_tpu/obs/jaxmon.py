@@ -32,7 +32,8 @@ from .recorder import recorder
 from .tracer import tracer
 
 __all__ = ["install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
-           "record_cost_analysis", "last_watermarks"]
+           "record_cost_analysis", "last_watermarks",
+           "device_capacity"]
 
 # a label re-compiling this many times is a storm (ragged batches)
 STORM_THRESHOLD = 8
@@ -197,3 +198,40 @@ def _host_peak_rss_bytes() -> int:
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
     except Exception:
         return 0
+
+
+def _host_total_bytes() -> int:
+    """Total physical host memory (the CPU-backend capacity stand-in);
+    0 when the platform can't say."""
+    try:
+        import os
+        return int(os.sysconf("SC_PHYS_PAGES")) * \
+            int(os.sysconf("SC_PAGE_SIZE"))
+    except (AttributeError, OSError, ValueError):
+        return 0
+
+
+_capacity_cache: Dict[str, float] = {}
+
+
+def device_capacity(devices=None) -> Dict[str, float]:
+    """Per-device memory capacity in bytes — the memwatch pressure
+    denominator.  Allocator backends (TPU/GPU) report ``bytes_limit``
+    in ``memory_stats()``; CPU backends fall back to total host RAM.
+    Cached after the first full read (capacities are static)."""
+    if devices is None and _capacity_cache:
+        return dict(_capacity_cache)
+    import jax
+    host = float(_host_total_bytes())
+    out: Dict[str, float] = {}
+    for d in (devices if devices is not None else jax.devices()):
+        key = f"{d.platform}:{d.id}"
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        cap = float(st.get("bytes_limit", 0) or 0) if st else 0.0
+        out[key] = cap if cap > 0 else host
+    if devices is None:
+        _capacity_cache.update(out)
+    return out

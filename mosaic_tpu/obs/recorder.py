@@ -191,6 +191,11 @@ class FlightRecorder:
                        "principals": meter.report()}
         except Exception:
             queries = {}
+        try:                    # device-memory ledger: who HOLDS live
+            from .memwatch import memwatch           # bytes right now —
+            device_memory = memwatch.snapshot()      # the mem-pressure
+        except Exception:                            # breach post-mortem
+            device_memory = {}
         b: Dict[str, Any] = {
             "reason": reason,
             "ts": time.time(),
@@ -200,6 +205,7 @@ class FlightRecorder:
             "metrics": metrics.report(),
             "timeseries": ts_snap,
             "memory": mem,
+            "device_memory": device_memory,
             "profile": prof,
             "queries": queries,
             "config": cfg,

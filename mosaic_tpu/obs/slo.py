@@ -138,6 +138,15 @@ def default_objectives() -> List[SLObjective]:
         SLObjective(name="shard_skew", kind="gauge_max",
                     series="shard/skew/pip_join", ceiling=8.0,
                     windows=(60.0, 300.0)),
+        # device-memory pressure: ledger-attributed live bytes at the
+        # effective capacity (budget or HBM) in both windows — the
+        # breach dump's bundle embeds the full ledger snapshot, so the
+        # post-mortem names the holders.  Clean runs sit near zero
+        # pressure; only a configured tiny budget (the mem-smoke
+        # drill) or real saturation crosses 1.0.
+        SLObjective(name="device_mem_pressure", kind="gauge_max",
+                    series="mem/pressure_max", ceiling=1.0,
+                    windows=(60.0, 300.0)),
     ]
 
 

@@ -373,7 +373,8 @@ def make_streamed_pip_join(idx, grid: IndexSystem,
 
         with root_trace("pip_join"), tracer.span("pip_join/streamed"):
             stream(chunk_rows(n, chunk), compute=fn, put=put,
-                   consume=consume, observe=observe)
+                   consume=consume, observe=observe,
+                   site="pip_join/streamed")
         if metrics.enabled:
             metrics.count("pip_join/streamed_points", float(n))
             metrics.count("pip_join/streamed_chunks",
@@ -617,7 +618,8 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
         with root_trace("pip_join"), \
                 tracer.span("pip_join/sharded_streamed"):
             stream(chunk_rows(n, chunk), compute=compute, put=put,
-                   consume=consume, observe=observe)
+                   consume=consume, observe=observe,
+                   site="pip_join/sharded")
         if metrics.enabled:
             # per-device wall-time attribution: the run's matched-row
             # counts per shard (summed over chunks) are the load share

@@ -593,7 +593,9 @@ def execute_group(group: FusionGroup, q: Query, env,
     disagrees with the plan."""
     import jax
     from ..obs.devicemon import devicemon
-    from ..obs.inflight import charge_h2d_bytes, checkpoint
+    from ..obs.inflight import (charge_d2h_bytes, charge_h2d_bytes,
+                                checkpoint)
+    from ..obs.memwatch import device_keys_of, memwatch
     from ..obs.profiler import ledger
     from ..resilience import faults
     from ..sql.engine import Table
@@ -644,13 +646,24 @@ def execute_group(group: FusionGroup, q: Query, env,
     charge_h2d_bytes(h2d)
     t0 = time.perf_counter()
     dev_out = fn(*padded, np.int64(n))
+    # the fused program's device outputs live from launch to the one
+    # group fetch below — register the span so the memory ledger can
+    # attribute the group's device footprint to this query's trace
+    mem_tok = memwatch.register(
+        f"fusion/{group.name}",
+        sum(int(getattr(o, "nbytes", 0)) for o in dev_out),
+        devices=device_keys_of(dev_out)) if memwatch.enabled else None
     host = list(jax.device_get(dev_out))      # the ONE group fetch
+    memwatch.release(mem_tok)
     wall = time.perf_counter() - t0
+    d2h = sum(int(h.nbytes) for h in host)
     if metrics.enabled:
         metrics.count("fusion/groups")
         metrics.count("fusion/fetches")
-        metrics.count("fusion/d2h_bytes",
-                      sum(int(h.nbytes) for h in host))
+        metrics.count("fusion/d2h_bytes", d2h)
+    # the fused fetch bypasses pipeline.stream, so charge the owning
+    # query here — same trace join the device-seconds charge uses
+    charge_d2h_bytes(d2h)
     ledger.observe(group.name, (bucket,), wall, rows=n)
     devicemon.attribute(group.name, wall)
     if not cold:

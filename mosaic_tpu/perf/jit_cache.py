@@ -80,7 +80,7 @@ class JitCache:
                 if metrics.enabled:
                     metrics.count("perf/jit_cache/hit")
                 return fn
-            fn = build()
+            fn = self._instrument(name, build())
             self._entries[full] = fn
             self.misses += 1
             if metrics.enabled:
@@ -101,6 +101,34 @@ class JitCache:
                 if metrics.enabled:
                     metrics.count("perf/jit_cache/evict")
         return fn
+
+    @staticmethod
+    def _instrument(name: str, fn: Callable) -> Callable:
+        """Wrap a freshly built kernel so each launch notes its output
+        bytes with the device-memory ledger (``memwatch``) as a
+        transient under ``jit/<name>`` — the attribution feed that
+        gives every cached operator (not just the streamed paths) a
+        per-trace peak-bytes figure.  Fully fenced: ledger trouble
+        never reaches the kernel, and non-callable cache entries pass
+        through untouched."""
+        if not callable(fn):
+            return fn
+
+        def _launch(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            try:
+                from ..obs.memwatch import memwatch
+                if memwatch.enabled:
+                    import jax
+                    nb = sum(int(getattr(leaf, "nbytes", 0)) for leaf
+                             in jax.tree_util.tree_leaves(out))
+                    if nb:
+                        memwatch.note_transient(f"jit/{name}", nb)
+            except Exception:
+                pass
+            return out
+
+        return _launch
 
     def __len__(self) -> int:
         with self._lock:

@@ -28,6 +28,7 @@ there to avoid the per-launch warning.
 
 from __future__ import annotations
 
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
@@ -37,6 +38,17 @@ from ..obs import metrics
 from ..resilience import faults
 
 __all__ = ["stream", "chunk_rows", "donate_jit"]
+
+#: fetches allowed in flight before the dispatch loop drains the
+#: oldest — double buffering needs exactly one fetch overlapping the
+#: next chunk's compute; anything beyond that only accumulates host
+#: and device buffers with total stream length
+_MAX_INFLIGHT_FETCHES = 2
+
+#: pressure-driven halving floor: a slice this short never splits
+#: (guards against a pathological budget dissolving the stream into
+#: per-row launches)
+_MIN_SHRINK_ROWS = 64
 
 
 def _tree_bytes(x) -> int:
@@ -72,7 +84,8 @@ def _to_host(out):
 def stream(chunks: Sequence, compute: Callable,
            put: Optional[Callable] = None,
            consume: Optional[Callable] = None,
-           observe: Optional[Callable] = None) -> list:
+           observe: Optional[Callable] = None,
+           site: str = "pipeline.stream") -> list:
     """Run ``chunks`` through the double-buffered pipeline; returns the
     per-chunk results in order.
 
@@ -94,6 +107,29 @@ def stream(chunks: Sequence, compute: Callable,
     (``pipeline/observe_errors``) and flight-recorded once per stream,
     and the chunk completes normally.
 
+    ``site`` names this stream in the device-memory ledger
+    (``obs.memwatch``): each chunk's staged input registers as
+    ``<site>/staged`` and its device output as ``<site>/out``, both
+    released when the worker's host fetch completes — so the ledger's
+    live-bytes gauges track the pipeline's true in-flight footprint
+    and the leak sentinel can name the site that failed to release.
+
+    Memory footprint is bounded two ways:
+
+    * the dispatch loop keeps at most ``_MAX_INFLIGHT_FETCHES``
+      fetches outstanding, resolving the oldest before dispatching
+      further — completed host chunks and queued work items no longer
+      accumulate with total stream length (double buffering is
+      preserved: the next chunk's compute still overlaps the previous
+      chunk's drain);
+    * under memory pressure (``obs.memwatch.mem_budget`` past
+      ``mosaic.mem.pressure.high``), the NEXT chunk — when it is a
+      row ``slice`` — is halved before staging (repeatedly, floor
+      ``_MIN_SHRINK_ROWS`` rows), counted in ``mem/chunk_shrink``.
+      Results stay bit-identical because consumers key on the slice
+      payload, not the chunk index: the same rows arrive, in order,
+      across more launches (degrade, not die).
+
     Cancellation: each loop iteration starts with an
     ``obs.inflight.checkpoint`` probe, so a query cancelled (or past
     its deadline) mid-stream stops within one chunk boundary.
@@ -107,18 +143,24 @@ def stream(chunks: Sequence, compute: Callable,
         return []
     import time as _time
     import jax
-    from ..obs.inflight import charge_h2d_bytes, checkpoint, inflight
+    from ..obs.inflight import (charge_d2h_bytes, charge_h2d_bytes,
+                                checkpoint, inflight)
+    from ..obs.memwatch import device_keys_of, mem_budget, memwatch
     if put is None:
         put = jax.device_put
-    dispatch_ts: list = [0.0] * len(chunks)
-    obs_state = {"last_done": 0.0, "observe_failed": False}
+    obs_state = {"last_done": 0.0, "observe_failed": False,
+                 "shrunk": False}
 
-    def fetch(i, payload, out):
+    def fetch(i, payload, out, dispatch_t, tok_in, tok_out):
         faults.maybe_fail("pipeline.fetch")
         host = _to_host(out)        # blocks the WORKER until ready
+        # the chunk's device buffers are drained: input consumed by the
+        # launch, output copied out — both leave the ledger here
+        memwatch.release(tok_out)
+        memwatch.release(tok_in)
         if observe is not None:     # single worker: in-order, race-free
             now = _time.perf_counter()
-            start = max(dispatch_ts[i], obs_state["last_done"])
+            start = max(dispatch_t, obs_state["last_done"])
             obs_state["last_done"] = now
             try:
                 observe(i, payload, now - start)
@@ -133,37 +175,90 @@ def stream(chunks: Sequence, compute: Callable,
                     recorder.record(
                         "pipeline_observe_error", chunk=i,
                         error=f"{type(exc).__name__}: {exc}")
-        if metrics.enabled:         # device->host drain, per chunk
-            metrics.count("pipeline/d2h_bytes", _tree_bytes(host))
+        if metrics.enabled or inflight._by_trace:
+            nb = _tree_bytes(host)  # device->host drain, per chunk
+            if metrics.enabled:
+                metrics.count("pipeline/d2h_bytes", nb)
+            charge_d2h_bytes(nb)    # per-query attribution
         return consume(i, payload, host) if consume is not None \
             else host
 
     def staged(payload):
         dev = put(payload)
+        tok = None
         # the tree walk is skipped entirely when nothing is listening
-        if metrics.enabled or inflight._by_trace:
+        if metrics.enabled or inflight._by_trace or memwatch.enabled:
             nb = _tree_bytes(dev)
             if metrics.enabled:     # host->device staging, per chunk
                 metrics.count("pipeline/h2d_bytes", nb)
             charge_h2d_bytes(nb)    # per-query attribution
-        return dev
+            if memwatch.enabled:
+                tok = memwatch.register(f"{site}/staged", nb,
+                                        devices=device_keys_of(dev))
+        return dev, tok
 
-    results: list = [None] * len(chunks)
+    def maybe_split(j):
+        # degrade-not-die: while any device sits past the pressure
+        # high-water mark, halve the next chunk's rows before staging
+        # it.  Only row slices split (all streamed call sites chunk by
+        # slice); consumers key on the slice, so the extra boundaries
+        # are invisible in the results.
+        while (mem_budget.shrink_needed()
+               and isinstance(chunks[j], slice)
+               and (chunks[j].stop - chunks[j].start) > _MIN_SHRINK_ROWS):
+            sl = chunks[j]
+            mid = (sl.start + sl.stop) // 2
+            chunks[j:j + 1] = [slice(sl.start, mid), slice(mid, sl.stop)]
+            if metrics.enabled:
+                metrics.count("mem/chunk_shrink")
+            if not obs_state["shrunk"]:   # flight-record once per stream
+                obs_state["shrunk"] = True
+                from ..obs import recorder
+                recorder.record("mem_chunk_shrink", site=site,
+                                rows=sl.stop - sl.start)
+
+    results: list = []
     with ThreadPoolExecutor(max_workers=1) as pool:
-        futs = []
-        dev = staged(chunks[0])
-        for i, payload in enumerate(chunks):
-            checkpoint("pipeline.stream")    # chunk-boundary cancel
-            # latency chaos: "pipeline.chunk" mode=delay stalls the
-            # dispatch loop (the cancellation drill's stall point —
-            # a cancel landing mid-stall raises at the NEXT chunk's
-            # checkpoint, one boundary later)
-            faults.stall("pipeline.chunk")
-            dispatch_ts[i] = _time.perf_counter()
-            out = compute(dev)
-            if i + 1 < len(chunks):
-                dev = staged(chunks[i + 1])  # overlap H2D with compute
-            futs.append(pool.submit(fetch, i, payload, out))
-        for i, f in enumerate(futs):
-            results[i] = f.result()
+        futs: deque = deque()
+        maybe_split(0)
+        dev, tok = staged(chunks[0])
+        try:
+            i = 0
+            while i < len(chunks):  # len() re-read: splits grow it
+                payload = chunks[i]
+                checkpoint("pipeline.stream")   # chunk-boundary cancel
+                # latency chaos: "pipeline.chunk" mode=delay stalls the
+                # dispatch loop (the cancellation drill's stall point —
+                # a cancel landing mid-stall raises at the NEXT chunk's
+                # checkpoint, one boundary later)
+                faults.stall("pipeline.chunk")
+                dispatch_t = _time.perf_counter()
+                out = compute(dev)
+                tok_out = memwatch.register(
+                    f"{site}/out", _tree_bytes(out),
+                    devices=device_keys_of(out)) \
+                    if memwatch.enabled else None
+                if i + 1 < len(chunks):
+                    maybe_split(i + 1)
+                    nxt = staged(chunks[i + 1])  # overlap H2D w/ compute
+                else:
+                    nxt = (None, None)
+                futs.append(pool.submit(fetch, i, payload, out,
+                                        dispatch_t, tok, tok_out))
+                dev, tok = nxt
+                # bounded in-flight window: resolve the oldest fetch
+                # once the window fills, so host results and queued
+                # work items stop scaling with total stream length
+                while len(futs) > _MAX_INFLIGHT_FETCHES:
+                    results.append(futs.popleft().result())
+                i += 1
+            while futs:
+                results.append(futs.popleft().result())
+        finally:
+            # a stream unwinding mid-loop (cancel, deadline, fault)
+            # has staged the next chunk without dispatching it — drop
+            # its registration so clean cancellation never reads as a
+            # leak (in-flight fetches release their own tokens as the
+            # executor exit joins the worker)
+            memwatch.release(tok)
     return results

@@ -323,10 +323,20 @@ class SQLSession:
             plan = planner.plan_query(q, self) if planner.enabled \
                 else None
             fplan = plan.fusion if plan is not None else None
+
+            def _est_bytes(o: str) -> int:
+                s = plan.steps.get(o) if plan is not None else None
+                return s.est_bytes if s is not None else -1
+            # est_bytes: the planner's byte pre-pass (cardinality x
+            # source row width; -1 = no estimate) — what the memory
+            # budget's admission check reads
             return Table({"operator": [o for o, _ in ops],
                           "detail": [d for _, d in ops],
                           "strategy": [plan.label(o) if plan is not None
                                        else "-" for o, _ in ops],
+                          "est_bytes": np.asarray(
+                              [_est_bytes(o) for o, _ in ops],
+                              np.int64),
                           "fused": [fplan.gid_for(o) if fplan is not None
                                     else "-" for o, _ in ops]})
         if q.explain == "analyze":
@@ -343,7 +353,10 @@ class SQLSession:
             # operator never touched a mesh — see obs.devicemon);
             # fused marks the operators a fusion group executed as one
             # XLA program — the group's device/wall time rolls up on
-            # its FIRST member's row, later members just unpack
+            # its FIRST member's row, later members just unpack;
+            # peak_bytes is the device-memory ledger's per-trace
+            # allocation delta while the stage ran (obs.memwatch —
+            # registered + transient bytes, 0 when the ledger is off)
             return Table({"operator": [p[0] for p in prof],
                           "detail": [p[1] for p in prof],
                           "rows": np.asarray([p[2] for p in prof],
@@ -357,7 +370,9 @@ class SQLSession:
                           "shard_skew": np.asarray(
                               [p[5] for p in prof]),
                           "device_ms": [p[7] for p in prof],
-                          "fused": [p[8] for p in prof]})
+                          "fused": [p[8] for p in prof],
+                          "peak_bytes": np.asarray(
+                              [p[9] for p in prof], np.int64)})
         return self._execute(q, None)
 
     def _plan_ops(self, q: Query) -> List[tuple]:
@@ -401,6 +416,15 @@ class SQLSession:
                                     note_rows as _note_rows,
                                     note_rows_in as _note_rows_in,
                                     note_strategies as _note_strategies)
+        from ..obs.memwatch import mem_budget as _mem_budget, \
+            memwatch as _memwatch
+        if plan is not None:
+            # advisory admission check against the planner's byte
+            # pre-pass: a denial is counted + flight-recorded (the
+            # admission-control arc's ground truth) but the query
+            # still runs — the stream degrades via chunk shrink
+            # instead of dying at the gate
+            _mem_budget.admit(plan.est_bytes_peak())
         if plan is not None:
             # strategy picks land on the active ticket here (not read
             # off self._active_plan at completion — that attribute is
@@ -446,6 +470,8 @@ class SQLSession:
             a2a0 = metrics.counter_value("collective/all_to_all_bytes")
             dev0 = devicemon.busy_by_device() if prof is not None \
                 else None
+            mem0 = _memwatch.current_trace_alloc_bytes() \
+                if prof is not None else 0
             with tracer.span(op):
                 t0 = time.perf_counter()
                 res = fn()
@@ -484,10 +510,16 @@ class SQLSession:
                 dev1 = devicemon.busy_by_device()
                 delta = {k: v - (dev0.get(k, 0.0) if dev0 else 0.0)
                          for k, v in dev1.items()}
+                # device bytes this stage allocated (registered +
+                # transient) under the query's trace — the EXPLAIN
+                # ANALYZE peak_bytes column; the per-trace allocation
+                # total is monotone, so the diff is stage-local
+                mem1 = _memwatch.current_trace_alloc_bytes()
                 prof.append((op, detail, rows, dt, int(a2a),
                              float(skew),
                              step.est_rows if step is not None else -1,
-                             format_device_ms(delta), gid))
+                             format_device_ms(delta), gid,
+                             max(0, int(mem1 - mem0))))
             if metrics.enabled:
                 metrics.observe(f"sql/{op}_s", dt)
             return res

@@ -108,6 +108,8 @@ class PlanStep:
     strategy: str = "-"
     reason: str = ""
     key_n: int = 0          # input rows the ratio estimate was keyed on
+    est_bytes: int = -1     # est_rows x source row width (-1 unknown)
+                            # — the memory budget's admission estimate
 
     @property
     def label(self) -> str:
@@ -132,6 +134,13 @@ class QueryPlan:
         s = self.steps.get(op)
         return s.est_rows if s is not None else -1
 
+    def est_bytes_peak(self) -> int:
+        """The widest single operator's byte estimate (stages run one
+        at a time, so the peak — not the sum — is what admission
+        checks against the budget); 0 when no step has one."""
+        return max((s.est_bytes for s in self.steps.values()
+                    if s.est_bytes > 0), default=0)
+
     def label(self, op: str) -> str:
         s = self.steps.get(op)
         return s.label if s is not None else "-"
@@ -139,6 +148,22 @@ class QueryPlan:
 
 def _bucket(n: int) -> int:
     return pow2_bucket(max(int(n), 1))
+
+
+def _row_bytes(table) -> int:
+    """Bytes per materialized row of a table: dtype itemsize summed
+    over columns, 8 per column without a dtype (object/geometry refs).
+    Feeds the ``est_bytes`` pre-pass — a width estimate, not an exact
+    footprint."""
+    try:
+        cols = table.columns
+    except Exception:
+        return 0
+    total = 0
+    for c in cols.values():
+        dt = getattr(c, "dtype", None)
+        total += int(getattr(dt, "itemsize", 0) or 8)
+    return total
 
 
 class Planner:
@@ -408,12 +433,14 @@ class Planner:
             return None
         plan = QueryPlan()
         nl = len(left)
+        row_width = _row_bytes(left)
         if q.join is not None:
             try:
                 right = session.table(q.join.name)
             except Exception:
                 return None
             nr = len(right)
+            row_width += _row_bytes(right)
             op = f"{q.join_kind}_join"
             n_in = nl + nr
             r = self.ratio(op, n_in)
@@ -486,6 +513,14 @@ class Planner:
             plan.add(PlanStep("limit", rows, "limit",
                               f"{_fmt_rows(rows)} rows (exact cap)",
                               key_n=key_n))
+        # byte pre-pass: cardinality x source row width per operator —
+        # the EXPLAIN est_bytes column and the memory budget's
+        # admit() estimate (a width heuristic, not an exact footprint:
+        # projections narrow, generators widen)
+        if row_width > 0:
+            for step in plan.steps.values():
+                if step.est_rows >= 0:
+                    step.est_bytes = int(step.est_rows) * row_width
         # fusion pass: walk the finished plan and group adjacent
         # eligible operators into whole-group XLA programs (gated per
         # size class by decide_fusion).  Degrade-not-die: a fusion

@@ -1,0 +1,355 @@
+"""Device-memory plane (``obs.memwatch``).
+
+Covers the acceptance surface of the memory PR: the live-buffer
+ledger balancing to zero across the streamed, sharded, and fused
+execution paths; the leak sentinel firing exactly one ``mem_leak``
+event (visible in the flight recorder, the ``mem/leaks`` counter,
+the OpenMetrics exposition, and the dashboard's ``/api/memory``);
+pressure-driven chunk halving preserving bit parity; disjoint
+per-query attribution under interleaved queries; budget admit/deny;
+the bounded in-flight stream window; and conf-key validation.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mosaic_tpu as mos
+from mosaic_tpu import config as _config
+from mosaic_tpu.obs import metrics, recorder, to_openmetrics
+from mosaic_tpu.obs.accounting import accounted, audit, meter
+from mosaic_tpu.obs.memwatch import mem_budget, memwatch
+from mosaic_tpu.resilience import faults
+
+
+@pytest.fixture
+def clean_mem():
+    """Reset the obs singletons + the ledger around each test, and
+    restore the process config (budget keys are mutated here)."""
+    prev = _config.default_config()
+    audit.reset()
+    meter.reset()
+    metrics.reset()
+    metrics.enable()
+    recorder.reset()
+    recorder.enable()
+    memwatch.reset()
+    yield
+    faults.disarm()
+    _config.set_default_config(prev)
+    audit.reset()
+    meter.reset()
+    metrics.disable()
+    metrics.reset()
+    recorder.reset()
+    memwatch.reset()
+
+
+def _set_conf(key, value):
+    _config.set_default_config(
+        _config.apply_conf(_config.default_config(), key, value))
+
+
+@pytest.fixture
+def session():
+    ctx = mos.enable_mosaic("CUSTOM(-180,180,-90,90,2,360,180)")
+    s = mos.SQLSession(ctx)
+    s.create_table("pts", {"x": np.arange(100.0),
+                           "y": np.arange(100.0) / 10.0})
+    return s
+
+
+def _streamed_join(npts=8192, chunk=2048):
+    """A tiny warm streamed PIP join (the flagship shape)."""
+    from mosaic_tpu import read_wkt
+    from mosaic_tpu.core.index.custom import CustomIndexSystem, GridConf
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                              make_streamed_pip_join)
+    grid = CustomIndexSystem(GridConf(0, 16, 0, 16, 2, 1.0, 1.0))
+    arr = read_wkt(
+        ["POLYGON ((1.3 1.7, 6.8 2.1, 5.9 6.3, 2.2 5.8, 1.3 1.7))",
+         "POLYGON ((8.5 1.5, 14.5 1.5, 14.5 6.5, 8.5 6.5, 8.5 1.5))"])
+    idx = build_pip_index(arr, 1, grid, chips=tessellate(arr, 1, grid))
+    pts = np.random.default_rng(3).uniform(0, 16, (npts, 2))
+    sjoin = make_streamed_pip_join(idx, grid, polys=arr, chunk=chunk)
+    sjoin(pts)                                # warm (compile)
+    return sjoin, pts, (idx, grid, arr)
+
+
+def _raw_stream(data, chunk, observe=None, site="pipeline.stream"):
+    """stream() over a host vector with a trivial jitted kernel;
+    returns the concatenated doubled output."""
+    import jax
+    import jax.numpy as jnp
+    from mosaic_tpu.perf.pipeline import chunk_rows, stream
+    fn = jax.jit(lambda x: x * 2.0)
+    out = stream(chunk_rows(len(data), chunk), compute=fn,
+                 put=lambda sl: jax.device_put(
+                     jnp.asarray(data[sl])),
+                 consume=lambda i, sl, host: np.asarray(host),
+                 observe=observe, site=site)
+    return np.concatenate(out)
+
+
+def _assert_books_balanced():
+    assert memwatch.total_live() == 0
+    assert memwatch.live_buffers() == 0
+    snap = memwatch.snapshot()
+    assert snap["totals"]["live_bytes"] == 0
+    assert snap["totals"]["registered"] == snap["totals"]["released"]
+    for dev in snap["devices"].values():
+        assert dev["live_bytes"] == 0
+        assert dev["peak_bytes"] > 0
+    for d in memwatch.live_by_device():
+        assert metrics.report()["gauges"][f"mem/live_bytes/{d}"] == 0.0
+
+
+# ----------------------------------------------- ledger balance
+
+def test_streamed_join_books_balance(clean_mem):
+    sjoin, pts, _ = _streamed_join()
+    memwatch.reset()                          # drop the warm run
+    sjoin(pts)
+    _assert_books_balanced()
+    snap = memwatch.snapshot()
+    sites = snap["site_peak_bytes"]
+    assert sites.get("pip_join/streamed/staged", 0) > 0
+    assert sites.get("pip_join/streamed/out", 0) > 0
+    assert memwatch.leak_count() == 0
+
+
+def test_sharded_join_books_balance(clean_mem):
+    import jax
+    from mosaic_tpu.parallel.pip_join import make_sharded_streamed_pip_join
+    sjoin, pts, (idx, grid, arr) = _streamed_join()
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
+    shj = make_sharded_streamed_pip_join(idx, grid, mesh, polys=arr,
+                                         chunk=2048)
+    z_ref, _ = sjoin(pts)
+    memwatch.reset()
+    z_sh, _ = shj(pts)
+    assert np.array_equal(z_sh, z_ref)
+    _assert_books_balanced()
+    snap = memwatch.snapshot()
+    assert snap["site_peak_bytes"].get("pip_join/sharded/staged", 0) > 0
+    # a sharded staged buffer splits its bytes across the mesh devices
+    assert len(snap["devices"]) >= 2
+
+
+def test_fused_query_books_balance(clean_mem, session):
+    _set_conf("mosaic.planner.force.fusion", "on")
+    out = session.sql("SELECT count(*) AS n FROM pts "
+                      "WHERE x < 50 AND y > 0.5")
+    assert len(out) == 1
+    assert metrics.counter_value("fusion/groups") >= 1
+    _assert_books_balanced()
+    snap = memwatch.snapshot()
+    assert any(s.startswith("fusion/")
+               for s in snap["site_peak_bytes"])
+    assert memwatch.leak_count() == 0
+
+
+# ----------------------------------------------- leak sentinel
+
+def test_leak_drill_exactly_one_event_everywhere(clean_mem):
+    from mosaic_tpu.obs import serve_dashboard
+    sjoin, pts, _ = _streamed_join()
+    memwatch.reset()
+    faults.arm("site=memwatch.release,fails=1,error=OSError")
+    with accounted("leak-drill", principal="mallory"):
+        sjoin(pts)
+    # exactly one mem_leak event, naming a pipeline site
+    evs = recorder.events("mem_leak")
+    assert len(evs) == 1
+    assert evs[0]["site"].startswith("pip_join/streamed")
+    assert evs[0]["bytes"] > 0 and evs[0]["buffers"] == 1
+    assert metrics.counter_value("mem/leaks") == 1
+    assert metrics.counter_value("mem/release_skipped") == 1
+    assert memwatch.leak_count() == 1
+    # ...and the sentinel force-released: gauges return to zero
+    assert memwatch.total_live() == 0
+    assert memwatch.live_buffers() == 0
+    # visible in the OpenMetrics exposition
+    om = to_openmetrics()
+    assert "mosaic_mem_leaks_total 1" in om
+    # ...and on the dashboard's memory endpoint + page
+    with serve_dashboard(port=0) as h:
+        base = f"http://127.0.0.1:{h.port}"
+        with urllib.request.urlopen(base + "/api/memory",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["totals"]["leaks"] == 1
+        assert len(snap["leaks"]) == 1
+        assert snap["leaks"][0]["site"].startswith("pip_join/streamed")
+        with urllib.request.urlopen(base + "/memory", timeout=10) as r:
+            assert r.status == 200
+    # a clean follow-up query adds no further leak events
+    with accounted("clean", principal="mallory"):
+        sjoin(pts)
+    assert len(recorder.events("mem_leak")) == 1
+    assert memwatch.leak_count() == 1
+
+
+def test_clean_queries_never_fire_the_sentinel(clean_mem):
+    sjoin, pts, _ = _streamed_join()
+    memwatch.reset()
+    for _ in range(3):
+        with accounted("clean", principal="alice"):
+            sjoin(pts)
+    assert recorder.events("mem_leak") == []
+    assert metrics.counter_value("mem/leaks") == 0
+    assert memwatch.total_live() == 0
+
+
+# ----------------------------------------------- pressure / shrink
+
+def test_chunk_shrink_preserves_bit_parity(clean_mem):
+    sjoin, pts, _ = _streamed_join(npts=4096, chunk=2048)
+    z_ref, r_ref = sjoin(pts)
+    # a budget below one staged chunk (2048 rows x 16 B) pins every
+    # device past the pressure high-water mark while anything is live
+    _set_conf("mosaic.mem.budget.bytes", "24000")
+    z_lo, r_lo = sjoin(pts)
+    assert np.array_equal(z_lo, z_ref)        # degrade, not die
+    assert r_lo == r_ref
+    assert metrics.counter_value("mem/chunk_shrink") > 0
+    assert len(recorder.events("mem_chunk_shrink")) >= 1
+    assert memwatch.total_live() == 0
+    assert memwatch.leak_count() == 0
+
+
+def test_raw_stream_shrink_parity_and_counter(clean_mem):
+    data = np.arange(8192, dtype=np.float64)
+    ref = _raw_stream(data, 1024)
+    assert np.array_equal(ref, data * 2.0)
+    _set_conf("mosaic.mem.budget.bytes", "6000")   # < one 8 KiB chunk
+    _set_conf("mosaic.mem.pressure.high", "0.5")
+    lo = _raw_stream(data, 1024)
+    assert np.array_equal(lo, ref)
+    assert metrics.counter_value("mem/chunk_shrink") > 0
+
+
+# ----------------------------------------------- attribution
+
+def test_interleaved_queries_disjoint_attribution(clean_mem):
+    """Two concurrent streams: the small query's recorded peak must
+    stay below even ONE of the big query's chunks — cross-charging
+    would blow straight past that bound."""
+    small = np.arange(512, dtype=np.float64)       # 1 KiB chunks
+    big = np.arange(65536, dtype=np.float64)       # 128 KiB chunks
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def run(name, data, chunk):
+        try:
+            barrier.wait(timeout=10)
+            with accounted(name, principal=name):
+                _raw_stream(data, chunk)
+        except Exception as e:                     # surface in main
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=("small", small, 128)),
+          threading.Thread(target=run, args=("big", big, 16384))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs
+    recs = {r["principal"]: r for r in audit.records()}
+    big_chunk_bytes = 16384 * 8
+    assert recs["big"]["cost"]["mem_peak_bytes"] >= big_chunk_bytes
+    assert 0 < recs["small"]["cost"]["mem_peak_bytes"] < big_chunk_bytes
+    assert recs["small"]["trace"] != recs["big"]["trace"]
+    assert memwatch.total_live() == 0
+    assert memwatch.leak_count() == 0
+
+
+# ----------------------------------------------- budget / admission
+
+def test_budget_admit_and_deny(clean_mem):
+    assert mem_budget.admit(1 << 40)               # no budget: always
+    _set_conf("mosaic.mem.budget.bytes", "10000")
+    tok = memwatch.register("test/hold", 6000)
+    try:
+        assert mem_budget.admit(3000) is True
+        assert mem_budget.admit(5000) is False     # 6000 + 5000 > 10000
+        assert metrics.counter_value("mem/admit_denied") == 1
+        evs = recorder.events("mem_admit_denied")
+        assert len(evs) == 1
+        assert evs[0]["live_bytes"] == 6000
+        assert evs[0]["budget_bytes"] == 10000
+    finally:
+        memwatch.release(tok)
+    assert mem_budget.admit(9999) is True
+
+
+def test_shrink_needed_tracks_pressure(clean_mem):
+    _set_conf("mosaic.mem.budget.bytes", "10000")
+    _set_conf("mosaic.mem.pressure.high", "0.8")
+    assert mem_budget.shrink_needed() is False
+    tok = memwatch.register("test/hold", 9000)     # pressure 0.9
+    try:
+        assert mem_budget.shrink_needed() is True
+        assert memwatch.max_pressure() >= 0.8
+    finally:
+        memwatch.release(tok)
+    assert mem_budget.shrink_needed() is False
+
+
+# ----------------------------------------------- stream window bound
+
+def test_stream_window_bounds_inflight_buffers(clean_mem):
+    """Satellite regression: over a long stream the ledger's live
+    buffer count stays a small constant — completed chunks leave the
+    pipeline instead of accumulating with stream length."""
+    state = {"max_buffers": 0}
+
+    def observe(i, sl, seconds):
+        state["max_buffers"] = max(state["max_buffers"],
+                                   memwatch.live_buffers())
+
+    data = np.arange(40 * 256, dtype=np.float64)
+    out = _raw_stream(data, 256, observe=observe)
+    assert np.array_equal(out, data * 2.0)
+    # 40 chunks; window = 2 in-flight fetches (2 tokens each) + the
+    # dispatched chunk + the prefetched next -> never near 40
+    assert 0 < state["max_buffers"] <= 10
+    assert memwatch.live_buffers() == 0
+
+
+# ----------------------------------------------- switches / conf
+
+def test_memwatch_disabled_tracks_nothing(clean_mem):
+    _set_conf("mosaic.obs.mem.enabled", "false")
+    assert memwatch.enabled is False
+    assert memwatch.register("test/x", 1024) is None
+    data = np.arange(1024, dtype=np.float64)
+    out = _raw_stream(data, 256)
+    assert np.array_equal(out, data * 2.0)
+    assert memwatch.snapshot()["totals"]["registered"] == 0
+    # budget checks pass through when the ledger is off
+    _set_conf("mosaic.mem.budget.bytes", "1")
+    assert mem_budget.admit(1 << 30) is True
+    assert mem_budget.shrink_needed() is False
+
+
+def test_conf_keys_validate():
+    cfg = _config.MosaicConfig()
+    cfg = _config.apply_conf(cfg, "mosaic.mem.budget.bytes", "1048576")
+    assert cfg.mem_budget_bytes == 1048576
+    cfg = _config.apply_conf(cfg, "mosaic.mem.budget.bytes", "0")
+    assert cfg.mem_budget_bytes == 0              # 0 = unlimited
+    for bad in ("abc", "-1", "1.5"):
+        with pytest.raises(_config.ConfigError):
+            _config.apply_conf(cfg, "mosaic.mem.budget.bytes", bad)
+    cfg = _config.apply_conf(cfg, "mosaic.mem.pressure.high", "0.6")
+    assert cfg.mem_pressure_high == 0.6
+    for bad in ("0", "1.5", "-0.2", "nope"):
+        with pytest.raises(_config.ConfigError):
+            _config.apply_conf(cfg, "mosaic.mem.pressure.high", bad)
+    cfg = _config.apply_conf(cfg, "mosaic.obs.mem.enabled", "false")
+    assert cfg.obs_mem_enabled is False

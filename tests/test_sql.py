@@ -399,6 +399,28 @@ def test_explain_and_explain_analyze(session):
     assert list(agg.columns["fused"]) == ["-", "-"]
 
 
+def test_explain_est_bytes_and_peak_bytes(session):
+    """EXPLAIN carries the planner's pre-pass byte estimate
+    (``est_rows`` x source row width, -1 when unknown); EXPLAIN
+    ANALYZE carries the observed per-stage device-memory allocation
+    from the ledger (0 for host-only stages)."""
+    session.create_table("eb", {
+        "k": np.arange(8, dtype=np.int64),        # 8 B
+        "v": np.arange(8, dtype=np.float64)})     # + 8 B = 16 B/row
+    plan = session.sql("EXPLAIN SELECT k FROM eb WHERE v > 1.5")
+    est = dict(zip(plan.columns["operator"],
+                   plan.columns["est_bytes"].tolist()))
+    assert plan.columns["est_bytes"].dtype == np.int64
+    assert est["scan"] == 8 * 16      # scan cardinality is exact
+    assert all(b == -1 or b >= 0 for b in est.values())
+    out = session.sql("EXPLAIN ANALYZE SELECT k FROM eb WHERE v > 1.5")
+    peak = out.columns["peak_bytes"]
+    assert peak.dtype == np.int64 and len(peak) == 3
+    # host-only stages allocate no device memory; nothing negative
+    assert all(b >= 0 for b in peak.tolist())
+    session.drop_table("eb")
+
+
 def test_explain_fused_column(session):
     """EXPLAIN/EXPLAIN ANALYZE surface the fusion group id on every
     member operator once the query clears the fusion crossover."""

@@ -38,8 +38,8 @@ import re
 import statistics
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["LOWER_BETTER", "HIGHER_BETTER", "load_history", "analyze",
-           "to_markdown", "main"]
+__all__ = ["LOWER_BETTER", "HIGHER_BETTER", "TREND_ONLY",
+           "load_history", "analyze", "to_markdown", "main"]
 
 # Local copies of bench.perf_guard's metric direction lists (kept in
 # sync by tests/test_timeseries.py::test_watchdog_metric_lists).
@@ -53,9 +53,20 @@ LOWER_BETTER = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
                 "raster_to_grid_s"]
 HIGHER_BETTER = ["value", "knn_rows_per_sec", "sharded_pts_per_sec"]
 
+# Tracked for drift only (trends + variance spikes), never a guard
+# regression: device-memory footprint has no 20%-slip contract, but a
+# creeping peak is exactly the slow leak the trend table exists to
+# surface.  Dotted keys reach into nested record blocks.
+TREND_ONLY = ["memory.flagship_peak_bytes",
+              "memory.flagship_peak_bytes_per_row"]
+
 
 def _num(rec: dict, key: str) -> Optional[float]:
-    v = rec.get(key)
+    v: object = rec
+    for part in key.split("."):
+        if not isinstance(v, dict):
+            return None
+        v = v.get(part)
     return float(v) if isinstance(v, (int, float)) and v else None
 
 
@@ -134,8 +145,9 @@ def analyze(history: List[Tuple[str, dict]], current: dict,
     regressions: List[str] = []
     spikes: List[str] = []
     trends: Dict[str, dict] = {}
-    for key in LOWER_BETTER + HIGHER_BETTER:
+    for key in LOWER_BETTER + HIGHER_BETTER + TREND_ONLY:
         lower = key in LOWER_BETTER
+        trend_only = key in TREND_ONLY
         cur = _num(current, key)
         traj = [v for v in (_num(r, key) for _, r in hist)
                 if v is not None]
@@ -144,7 +156,9 @@ def analyze(history: List[Tuple[str, dict]], current: dict,
         trends[key] = {
             "history": [round(v, 3) for v in traj],
             "current": round(cur, 3) if cur is not None else None,
-            "direction": "lower_better" if lower else "higher_better",
+            "direction": ("trend" if trend_only
+                          else "lower_better" if lower
+                          else "higher_better"),
         }
         if cur is None:
             continue
@@ -154,7 +168,7 @@ def analyze(history: List[Tuple[str, dict]], current: dict,
             base = statistics.median(base_vals)
             trends[key]["baseline"] = round(base, 3)
             ratio = cur / base if base else None
-            if ratio is not None and (
+            if ratio is not None and not trend_only and (
                     ratio > 1.0 + slip if lower else ratio < 1.0 - slip):
                 regressions.append(
                     f"{key}: median {base:g} -> {cur:g} "
