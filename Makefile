@@ -1,0 +1,23 @@
+# Convenience targets mirroring the CI lanes (see
+# .github/workflows/test.yml).  Everything is plain python — no build
+# step, no generated code.
+
+PYTHON ?= python
+
+.PHONY: lint lint-rules lint-baseline test
+
+# The CI gate: fail on any new finding OR a stale baseline entry.
+lint:
+	$(PYTHON) tools/graftlint.py --check
+
+# Print the rule catalogue (docs/usage/linting.md has the prose).
+lint-rules:
+	$(PYTHON) tools/graftlint.py --list-rules
+
+# Rewrite tools/graftlint_baseline.json for current findings; fill in
+# every TODO reason before committing.
+lint-baseline:
+	$(PYTHON) tools/graftlint.py --update-baseline
+
+test:
+	$(PYTHON) -m pytest tests -q

@@ -1,0 +1,41 @@
+"""graftlint: repo-native static analysis for mosaic_tpu.
+
+The invariants this codebase rests on are mostly *dynamic* — warm runs
+compile zero kernels, allocations flow through memwatch, singletons
+mutate under their locks, conf keys stay in sync with validators and
+docs.  The test suite proves them for the paths it exercises; this
+package proves the *code shape* that keeps them true everywhere, at
+lint time, on every PR.
+
+Four rule families (see ``docs/usage/linting.md`` for the catalogue):
+
+* ``jit-*``      — JAX jit hygiene: no host syncs inside compiled
+  functions, no raw ``jax.jit`` / bare ``device_put`` bypassing the
+  kernel-cache / memwatch choke points;
+* ``lock-*``     — lock discipline: classes holding a ``_lock`` mutate
+  shared attributes under it; module globals in lock-bearing modules
+  mutate under the module lock;
+* ``contract-*`` — contract drift: conf keys vs. ``config.py``
+  validators vs. docs, metric names vs. OpenMetrics rules, recorder
+  events vs. the declared catalogue, fault sites vs. chaos coverage;
+* ``cancel-*``   — cooperative-cancellation coverage: chunk loops and
+  operator boundaries call the inflight checkpoint.
+
+Pure stdlib (``ast`` + ``re``), driven by ``tools/graftlint.py``.
+Per-line suppressions (``# graftlint: ignore[rule-id] — reason``) and
+a committed baseline (``tools/graftlint_baseline.json``) grandfather
+intentional or historical findings without silencing the rule.
+"""
+
+from .core import (Finding, Module, Repo, RULES, all_rules, run_lint,
+                   load_baseline, apply_baseline, baseline_from_findings)
+
+# importing the rule modules registers them with core.RULES
+from . import rules_jit      # noqa: F401  (registration side effect)
+from . import rules_locks    # noqa: F401
+from . import rules_contracts  # noqa: F401
+from . import rules_cancel   # noqa: F401
+
+__all__ = ["Finding", "Module", "Repo", "RULES", "all_rules",
+           "run_lint", "load_baseline", "apply_baseline",
+           "baseline_from_findings"]

@@ -1,0 +1,253 @@
+"""jit hygiene rules.
+
+Three invariants, all load-bearing for warm-zero-compile and the
+accounting planes:
+
+* a function handed to ``jax.jit`` must stay on device — any host sync
+  inside it (``float()``/``int()``/``bool()`` on traced values,
+  ``np.asarray``, ``.block_until_ready()``, ``print``, conf reads)
+  either crashes at trace time or, worse, silently bakes a traced
+  constant / forces a device round-trip per call;
+* ``jax.jit`` itself is a choke point: kernels compile through
+  ``perf.jit_cache.kernel_cache`` (one LRU, one eviction policy,
+  hit/miss counters, KernelLedger seeding) — a raw ``jax.jit`` call
+  builds an invisible kernel that recompiles per call site and never
+  shows up in profiler attribution;
+* ``jax.device_put`` is likewise choked through ``perf.pipeline``
+  staging (H2D byte accounting + memwatch registration) — a bare call
+  moves bytes no ledger sees.
+
+The sanctioned shapes the rules recognise:
+
+* ``kernel_cache.get_or_build(name, key, build)`` where ``build`` (a
+  lambda or a function referenced by name, anywhere in the repo) wraps
+  the ``jax.jit`` call;
+* ``perf.pipeline.donate_jit`` / the ``perf`` choke-point modules
+  themselves;
+* ``put``-callbacks handed to ``perf.pipeline.stream`` (their
+  ``device_put`` is invoked through ``stream``'s accounting wrapper).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import (Finding, Module, Repo, dotted, enclosing, rule,
+                   under_with)
+
+#: modules that ARE the choke points (they may call the raw API)
+CHOKE_POINT_MODULES = {
+    "mosaic_tpu/perf/jit_cache.py",
+    "mosaic_tpu/perf/pipeline.py",
+}
+
+_JIT_NAMES = {"jax.jit", "jax.pjit"}
+_DEVICE_PUT_NAMES = {"jax.device_put", "device_put"}
+
+#: calls that synchronize with / read from the host inside a trace
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "print"}
+_HOST_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "np.frombuffer",
+                    "default_config", "config.default_config",
+                    "_config.default_config"}
+
+
+def _in_scope(m: Module) -> bool:
+    return m.path.startswith("mosaic_tpu/") and m.tree is not None
+
+
+def _jit_call_names(m: Module) -> Set[str]:
+    """Spellings of ``jax.jit`` live in this module (``jax.jit`` plus
+    a bare ``jit`` when imported from jax)."""
+    names = set(_JIT_NAMES)
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name in ("jit", "pjit"):
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _builder_names(repo: Repo) -> Set[str]:
+    """Function names referenced inside any ``*.get_or_build(...)``
+    call's arguments, repo-wide — the sanctioned builder indirection
+    (``kernel_cache.get_or_build("k", key, build)`` or
+    ``... lambda: _build_program(...)``)."""
+    out: Set[str] = set()
+    for m in repo.all_code_modules():
+        if m.tree is None:
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d or not d.endswith("get_or_build"):
+                continue
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+                    elif isinstance(sub, ast.Attribute):
+                        out.add(sub.attr)
+    return out
+
+
+def _inside_get_or_build(node: ast.AST, m: Module) -> bool:
+    for anc in enclosing(node, m.parents, (ast.Call,)):
+        d = dotted(anc.func)
+        if d and d.endswith("get_or_build"):
+            return True
+    return False
+
+
+def _enclosing_fn_names(node: ast.AST, m: Module) -> List[str]:
+    return [fn.name for fn in enclosing(
+        node, m.parents, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+# ------------------------------------------------------- jit-raw-jit
+
+@rule("jit-raw-jit", "jit",
+      "jax.jit outside perf choke points must go through "
+      "kernel_cache.get_or_build (warm-zero-compile + ledger "
+      "attribution depend on it)")
+def check_raw_jit(repo: Repo) -> Iterable[Finding]:
+    builders = _builder_names(repo)
+    for m in repo.modules:
+        if not _in_scope(m) or m.path in CHOKE_POINT_MODULES:
+            continue
+        jit_names = _jit_call_names(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d not in jit_names:
+                continue
+            if _inside_get_or_build(node, m):
+                continue
+            if any(fn in builders
+                   for fn in _enclosing_fn_names(node, m)):
+                continue
+            yield m.finding(
+                "jit-raw-jit", node,
+                f"raw {d}() bypasses perf.jit_cache.kernel_cache — "
+                "wrap the builder in get_or_build so the kernel is "
+                "bounded, counted, and ledger-attributed")
+
+
+# ------------------------------------------------ jit-raw-device-put
+
+@rule("jit-raw-device-put", "jit",
+      "bare jax.device_put outside perf.pipeline staging bypasses "
+      "H2D byte accounting and the memwatch ledger")
+def check_raw_device_put(repo: Repo) -> Iterable[Finding]:
+    for m in repo.modules:
+        if not _in_scope(m) or m.path in CHOKE_POINT_MODULES:
+            continue
+        # functions handed to stream(..., put=...) are staging
+        # callbacks: stream() wraps them with the byte/ledger
+        # accounting, so their device_put IS the choke point
+        put_fns: Set[str] = {"put"}
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d and d.split(".")[-1] == "stream":
+                    for kw in node.keywords:
+                        if kw.arg == "put":
+                            pd = dotted(kw.value)
+                            if pd:
+                                put_fns.add(pd.split(".")[-1])
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d not in _DEVICE_PUT_NAMES:
+                continue
+            if any(fn in put_fns
+                   for fn in _enclosing_fn_names(node, m)):
+                continue
+            yield m.finding(
+                "jit-raw-device-put", node,
+                "bare device_put — stage through perf.pipeline "
+                "(or a put= callback handed to stream) so H2D bytes "
+                "and live-buffer tracking see the transfer")
+
+
+# ------------------------------------------------------ jit-host-sync
+
+def _jitted_function_nodes(m: Module, jit_names: Set[str]
+                           ) -> List[ast.AST]:
+    """Function/lambda nodes this module compiles: first args of jit
+    calls (inline or referenced by name), plus @jax.jit /
+    @partial(jax.jit, ...) decorated defs."""
+    local_defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(m.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs[node.name] = node
+    out: List[ast.AST] = []
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d in jit_names or d == "donate_jit":
+                if node.args:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Lambda):
+                        out.append(a0)
+                    else:
+                        ref = dotted(a0)
+                        if ref and ref in local_defs:
+                            out.append(local_defs[ref])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dd = dotted(dec)
+                if dd in jit_names:
+                    out.append(node)
+                elif isinstance(dec, ast.Call):
+                    dd = dotted(dec.func)
+                    if dd in ("partial", "functools.partial") and \
+                            dec.args and \
+                            dotted(dec.args[0]) in jit_names:
+                        out.append(node)
+    return out
+
+
+@rule("jit-host-sync", "jit",
+      "host synchronization inside a jitted function: "
+      "float/int/bool on traced values, np.asarray, "
+      ".block_until_ready/.item/.tolist, print, or a conf read")
+def check_host_sync(repo: Repo) -> Iterable[Finding]:
+    for m in repo.modules:
+        if not _in_scope(m):
+            continue
+        jit_names = _jit_call_names(m)
+        seen: Set[int] = set()
+        for fn in _jitted_function_nodes(m, jit_names):
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or \
+                            id(node) in seen:
+                        continue
+                    seen.add(id(node))
+                    d = dotted(node.func)
+                    what: Optional[str] = None
+                    if d in _HOST_SYNC_BUILTINS:
+                        # float("nan") / int(0) style constant folding
+                        # is trace-safe; only traced operands sync
+                        if node.args and not all(
+                                isinstance(a, ast.Constant)
+                                for a in node.args):
+                            what = f"{d}() on a traced value"
+                    elif d in _HOST_SYNC_CALLS:
+                        what = f"{d}() (host round-trip / conf read)"
+                    elif isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _HOST_SYNC_ATTRS:
+                        what = f".{node.func.attr}() (device sync)"
+                    if what:
+                        yield m.finding(
+                            "jit-host-sync", node,
+                            f"{what} inside a jit-compiled function — "
+                            "breaks async dispatch (or bakes a traced "
+                            "constant); hoist it out of the kernel")

@@ -165,7 +165,8 @@ class AuditLog:
             except OSError:
                 # retention is best-effort; never fail the query over
                 # a full disk — surface it as a counter instead
-                self._spool_errors += 1
+                with self._lock:
+                    self._spool_errors += 1
                 if metrics.enabled:
                     metrics.count("audit/spool_errors")
 

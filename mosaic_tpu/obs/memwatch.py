@@ -356,7 +356,8 @@ class DeviceMemoryLedger:
             except Exception:
                 cap = 0.0
         if cap > 0:
-            self._capacity[dev] = cap
+            with self._lock:
+                self._capacity[dev] = cap
         return cap
 
     def effective_capacity(self, dev: str) -> float:

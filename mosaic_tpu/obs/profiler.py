@@ -139,14 +139,15 @@ class HostProfiler:
                         self._trace_names[ctx.trace_id] = ctx.name
                 else:
                     self.truncated += 1
-        self.samples += 1
+        with self._lock:
+            self.samples += 1
 
     def reset(self) -> None:
         with self._lock:
             self._stacks.clear()
             self._trace_names.clear()
-        self.samples = 0
-        self.truncated = 0
+            self.samples = 0
+            self.truncated = 0
 
     # -- reads / exports
     def report(self, max_stacks: Optional[int] = None) -> Dict[str, Any]:
@@ -248,7 +249,8 @@ class KernelLedger:
         self._costs: Dict[str, Dict[str, float]] = {}
         self.dropped = 0
 
-    def _entry(self, name: str, key) -> Optional[Dict[str, Any]]:
+    def _entry_locked(self, name: str, key
+                      ) -> Optional[Dict[str, Any]]:
         k = (name, repr(key))
         e = self._entries.get(k)
         if e is None:
@@ -265,7 +267,7 @@ class KernelLedger:
         if not self.enabled:
             return
         with self._lock:
-            self._entry(name, key)
+            self._entry_locked(name, key)
 
     def observe(self, name: str, key, seconds: float,
                 rows: int = 0) -> None:
@@ -273,7 +275,7 @@ class KernelLedger:
         if not self.enabled:
             return
         with self._lock:
-            e = self._entry(name, key)
+            e = self._entry_locked(name, key)
             if e is None:
                 return
             e["launches"] += 1
@@ -386,10 +388,13 @@ def configure_profiler(conf_hz: float) -> None:
     if os.environ.get(PROFILE_HZ_ENV):
         return
     hz = float(conf_hz)
-    prev = _conf_hz
-    if prev is not None and hz == prev:
-        return
-    _conf_hz = hz
+    with _prof_lock:
+        # check-and-set under the lock: two concurrent SETs reading
+        # the same prev would both decide to start/stop
+        prev = _conf_hz
+        if prev is not None and hz == prev:
+            return
+        _conf_hz = hz
     if hz > 0:
         start_profiler(hz)
     elif prev:

@@ -39,9 +39,41 @@ from typing import Any, Dict, List, Optional
 
 from .context import current_trace
 
-__all__ = ["FlightRecorder", "recorder", "install_excepthook"]
+__all__ = ["FlightRecorder", "EVENTS", "recorder",
+           "install_excepthook"]
 
 _DEF_CAPACITY = 4096
+
+#: The event catalogue: every ``kind`` string any ``record()`` call in
+#: the tree may emit.  Dashboards, dumps, and tests filter
+#: ``events(kind)`` by exact string — an undeclared kind is invisible
+#: to all of them, and a declared-but-unemitted kind is a dead panel.
+#: ``graftlint``'s contract-recorder-event rule enforces both
+#: directions; add the name here in the same PR that adds the emitter.
+EVENTS = frozenset({
+    # lifecycle / tracing
+    "span", "sql", "slow_query", "config", "audit",
+    # cancellation + accounting plane
+    "query_cancel_requested",
+    # resilience: retries, faults, degrade-not-die ingestion
+    "retry", "retry_recovered", "retry_giveup", "fault_injected",
+    "codec_error", "codec_record_dropped",
+    # jax / device plane
+    "jax_compile", "xla_cost", "device_trace",
+    # planner + fusion
+    "planner_decision", "planner_mispredict", "planner_stats_loaded",
+    "planner_stats_corrupt", "planner_stats_save_failed",
+    "fusion_group", "fusion_bailout", "fusion_plan_error",
+    # memory plane
+    "mem_admit_denied", "mem_chunk_shrink", "mem_leak",
+    # SLO + profiler
+    "slo_breach", "slo_recovered", "profiler",
+    # pipeline observer hook failures
+    "pipeline_observe_error",
+    # recorder-internal marks
+    "dump", "dump_suppressed", "dump_suppressed_flush", "error",
+    "unhandled_error",
+})
 
 
 def _jax_info() -> Dict[str, Any]:
@@ -87,9 +119,11 @@ class FlightRecorder:
 
     # -- switches
     def enable(self) -> None:
+        # graftlint: ignore[lock-unguarded-attr] — GIL-atomic bool store; record() reads it unlocked by design
         self._enabled = True
 
     def disable(self) -> None:
+        # graftlint: ignore[lock-unguarded-attr] — GIL-atomic bool store; record() reads it unlocked by design
         self._enabled = False
 
     @property

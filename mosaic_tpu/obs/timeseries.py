@@ -432,10 +432,13 @@ def configure_sampler(conf_ms: float) -> None:
     if os.environ.get("MOSAIC_TPU_OBS_SAMPLE_MS"):
         return
     ms = float(conf_ms)
-    prev = _conf_ms
-    if prev is not None and ms == prev:
-        return
-    _conf_ms = ms
+    with _sampler_lock:
+        # check-and-set under the lock: two concurrent SETs reading
+        # the same prev would both decide to start/stop
+        prev = _conf_ms
+        if prev is not None and ms == prev:
+            return
+        _conf_ms = ms
     if ms > 0:
         start_sampler(ms)
     elif prev:              # only stop what a conf actually started —

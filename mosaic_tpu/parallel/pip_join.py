@@ -1109,6 +1109,7 @@ def make_dense_pip_join_fn(idx: DensePIPIndex, eps: float = EPS_EDGE_DEG,
             from ..ops.pallas_projection import project_lattice_pallas
             face, ai, bi, margin, facegap = project_lattice_pallas(
                 points, idx.res,
+                # graftlint: ignore[jit-host-sync] — idx.origin is a host-side numpy constant closed over, folds at trace time
                 (float(idx.origin[0]), float(idx.origin[1])))
         else:
             face, ai, bi, margin, facegap = project_lattice_jax(

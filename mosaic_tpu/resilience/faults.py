@@ -180,7 +180,10 @@ class FaultPlan:
         return int.from_bytes(h[:8], "big") / 2.0 ** 64 < rule.rate
 
     def _record(self, site: str, n: int, kind: str) -> None:
-        self.injected.append((site, n, kind))
+        # probes fire on whatever thread hit the site; the ledger list
+        # shares the counter lock (callers never hold it here)
+        with self._lock:
+            self.injected.append((site, n, kind))
         metrics.count("faults/injected")
         metrics.count(f"faults/injected/{site}")
         from ..obs.recorder import recorder

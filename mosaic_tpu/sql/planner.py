@@ -626,7 +626,8 @@ class Planner:
         existing file.  Mirrors
         :func:`~mosaic_tpu.perf.jit_cache.configure_persistent_cache`."""
         if path:
-            self._stats_path = str(path)
+            with self._lock:
+                self._stats_path = str(path)
         resolved = self._resolve_stats_path()
         if resolved and not self._loaded:
             self.load(resolved)
@@ -641,7 +642,8 @@ class Planner:
         path = path or self._resolve_stats_path()
         if not path:
             return False
-        self._loaded = True
+        with self._lock:
+            self._loaded = True
         from ..resilience import faults
         try:
             faults.maybe_fail("planner.stats.load")

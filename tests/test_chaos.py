@@ -346,3 +346,99 @@ def test_overlay_survives_degraded_capacities(fault_plan):
     faults.disarm()
     want = overlay_host_truth(a, zones)
     assert np.array_equal(got, want)
+
+
+# ------------------------------------------- planner stats warm start
+
+def test_planner_stats_load_transient_io_cold_start(fault_plan,
+                                                    tmp_path):
+    """An injected read failure on ``planner.stats.load`` degrades to
+    a cold start (never raises); once the fault is spent the same file
+    warm-starts a fresh planner."""
+    from mosaic_tpu.sql.planner import Planner
+    path = str(tmp_path / "stats.json")
+    p = Planner()
+    p.observe_op("pip_join/streamed/c16", 32768, 0.050, rows_out=900)
+    assert p.save(path) == path
+
+    plan = fault_plan(
+        "seed=41;site=planner.stats.load,fails=1,error=OSError")
+    p2 = Planner()
+    assert p2.load(path) is False            # degraded: cold start
+    assert p2.ms_per_row("pip_join/streamed/c16", 32768) is None
+    assert ("planner.stats.load", 0, "OSError") in plan.injected
+
+    p3 = Planner()                           # fault spent: warm start
+    assert p3.load(path) is True
+    assert p3.ms_per_row("pip_join/streamed/c16", 32768) == \
+        pytest.approx(0.050 * 1e3 / 32768)
+
+
+# ------------------------------------------------ fusion group stall
+
+def test_fusion_group_stall_keeps_parity(fault_plan):
+    """Latency chaos at the ``fusion.group`` boundary: the injected
+    stall must not change what the fused program computes (parity vs
+    the unfused pin), only when it starts."""
+    from mosaic_tpu.functions.context import MosaicContext
+    from mosaic_tpu.sql import SQLSession
+
+    mc = MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)")
+    s = SQLSession(mc)
+    rng = np.random.default_rng(11)
+    s.create_table("cx", {"px": rng.normal(size=256),
+                          "k": rng.integers(0, 100, size=256)})
+    # fused sums are integer-only (float sums are order-dependent),
+    # so aggregate over k to keep the group eligible
+    q = "SELECT sum(k) AS t, count(*) AS n FROM cx WHERE k < 50"
+
+    prev = _config.default_config()
+    try:
+        _config.set_default_config(_config.apply_conf(
+            _config.default_config(),
+            "mosaic.planner.force.fusion", "on"))
+        plan = fault_plan(
+            "seed=42;site=fusion.group,mode=delay,fails=1,delay_ms=1")
+        fused = s.sql(q)
+        assert ("fusion.group", 0, "delay") in plan.injected
+        faults.disarm()
+        _config.set_default_config(_config.apply_conf(
+            _config.default_config(),
+            "mosaic.planner.force.fusion", "off"))
+        unfused = s.sql(q)
+        assert np.array_equal(np.asarray(fused.columns["t"]),
+                              np.asarray(unfused.columns["t"]))
+    finally:
+        _config.set_default_config(prev)
+
+
+# ------------------------------------------------- gpkg row corruption
+
+def test_gpkg_row_corruption_skip_drops_only_that_row(fault_plan,
+                                                      tmp_path):
+    """An injected per-row failure inside the GeoPackage feature loop
+    (``gpkg.read_row``) drops exactly that row in skip mode and leaves
+    the rest byte-identical; raise mode on the clean read matches the
+    original geometries."""
+    from mosaic_tpu.core.geometry.wkt import read_wkt, write_wkt
+    from mosaic_tpu.io.geopackage import read_gpkg, write_gpkg
+
+    geoms = read_wkt(["POINT (1 2)", "POINT (3 4)",
+                      "LINESTRING (0 0, 3 4)"])
+    path = str(tmp_path / "chaos.gpkg")
+    write_gpkg(path, geoms, {"name": ["a", "b", "c"]},
+               layer="t", srs_id=4326)
+
+    plan = fault_plan(
+        "seed=43;site=gpkg.read_row,fails=1,error=ValueError")
+    errors: list = []
+    got, cols = read_gpkg(path, on_error="skip", errors=errors)
+    assert write_wkt(got) == write_wkt(geoms)[1:]    # row 0 dropped
+    assert cols["name"] == ["b", "c"]
+    assert len(errors) == 1
+    assert ("gpkg.read_row", 0, "ValueError") in plan.injected
+
+    faults.disarm()                     # clean read: full parity
+    got2, cols2 = read_gpkg(path)
+    assert write_wkt(got2) == write_wkt(geoms)
+    assert cols2["name"] == ["a", "b", "c"]

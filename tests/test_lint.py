@@ -1,0 +1,563 @@
+"""graftlint self-tests.
+
+Every rule must demonstrably fire on a known-bad fixture and stay
+silent on its known-good twin (a lint rule that can't fail is worse
+than no rule: it certifies nothing).  Plus the framework contracts:
+inline suppressions, skip-file, parse-error surfacing, the baseline
+round-trip, and the CLI's JSON output schema.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mosaic_tpu import lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GRAFTLINT = os.path.join(REPO_ROOT, "tools", "graftlint.py")
+
+
+def run(rule_id, code=None, tools=None, tests=None, docs=None):
+    repo = lint.Repo.from_sources(code=code, tools=tools,
+                                  tests=tests, docs=docs)
+    return lint.run_lint(repo, [rule_id])
+
+
+def dedent(src):
+    return textwrap.dedent(src).lstrip("\n")
+
+
+# Minimal config.py / recorder.py stand-ins the contract rules parse.
+CONFIG_SRC = dedent("""
+    MOSAIC_PLANNER_FORCE_PREFIX = "mosaic.planner.force."
+    KEY_KNOWN = "mosaic.known.key"
+    _CONF_FIELDS = {
+        KEY_KNOWN: int,
+        "mosaic.other.key": str,
+    }
+""")
+
+RECORDER_SRC = dedent("""
+    EVENTS = frozenset({"boot", "tick"})
+""")
+
+
+# ------------------------------------------------------- jit hygiene
+
+class TestJitRules:
+    def test_raw_jit_fires(self):
+        src = dedent("""
+            import jax
+            square = jax.jit(lambda x: x * x)
+        """)
+        found = run("jit-raw-jit", code={"mosaic_tpu/k.py": src})
+        assert [f.rule for f in found] == ["jit-raw-jit"]
+        assert found[0].line == 2
+
+    def test_bare_jit_import_fires(self):
+        src = dedent("""
+            from jax import jit
+            square = jit(lambda x: x * x)
+        """)
+        assert run("jit-raw-jit", code={"mosaic_tpu/k.py": src})
+
+    def test_jit_via_get_or_build_passes(self):
+        src = dedent("""
+            import jax
+            from .perf.jit_cache import kernel_cache
+
+            def _build():
+                return jax.jit(lambda x: x * x)
+
+            def kernel(key):
+                return kernel_cache.get_or_build("square", key, _build)
+        """)
+        assert run("jit-raw-jit", code={"mosaic_tpu/k.py": src}) == []
+
+    def test_jit_in_choke_module_passes(self):
+        src = "import jax\nf = jax.jit(lambda x: x)\n"
+        assert run("jit-raw-jit",
+                   code={"mosaic_tpu/perf/jit_cache.py": src}) == []
+
+    def test_raw_device_put_fires(self):
+        src = dedent("""
+            import jax
+
+            def stage(chunk):
+                return jax.device_put(chunk)
+        """)
+        found = run("jit-raw-device-put",
+                    code={"mosaic_tpu/k.py": src})
+        assert [f.rule for f in found] == ["jit-raw-device-put"]
+
+    def test_device_put_in_stream_put_callback_passes(self):
+        src = dedent("""
+            import jax
+            from .perf.pipeline import stream
+
+            def _stage(chunk):
+                return jax.device_put(chunk)
+
+            def go(chunks):
+                return stream(chunks, put=_stage)
+        """)
+        assert run("jit-raw-device-put",
+                   code={"mosaic_tpu/k.py": src}) == []
+
+    def test_host_sync_in_jitted_fn_fires(self):
+        src = dedent("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return float(x) + 1.0
+
+            g = jax.jit(lambda x: np.asarray(x))
+        """)
+        found = run("jit-host-sync", code={"mosaic_tpu/k.py": src})
+        assert len(found) == 2
+        assert {f.line for f in found} == {6, 8}
+
+    def test_constant_fold_and_device_code_pass(self):
+        src = dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                nan = float("nan")
+                return x * 2 + nan
+
+            def host_side(x):
+                return float(x)
+        """)
+        assert run("jit-host-sync", code={"mosaic_tpu/k.py": src}) == []
+
+
+# ---------------------------------------------------- lock discipline
+
+class TestLockRules:
+    def test_unguarded_attr_fires(self):
+        src = dedent("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self.items = []
+
+                def bump(self):
+                    self.n += 1
+
+                def push(self, x):
+                    self.items.append(x)
+        """)
+        found = run("lock-unguarded-attr",
+                    code={"mosaic_tpu/c.py": src})
+        assert len(found) == 2
+        assert {f.line for f in found} == {10, 13}
+
+    def test_guarded_and_locked_helpers_pass(self):
+        src = dedent("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def _reset_locked(self):
+                    self.n = 0
+        """)
+        assert run("lock-unguarded-attr",
+                   code={"mosaic_tpu/c.py": src}) == []
+
+    def test_lockless_class_out_of_scope(self):
+        src = dedent("""
+            class Plain:
+                def bump(self):
+                    self.n = 1
+        """)
+        assert run("lock-unguarded-attr",
+                   code={"mosaic_tpu/c.py": src}) == []
+
+    def test_global_rebind_fires(self):
+        src = dedent("""
+            import threading
+
+            _lock = threading.Lock()
+            _conf = None
+
+            def configure(v):
+                global _conf
+                _conf = v
+        """)
+        found = run("lock-global-state", code={"mosaic_tpu/g.py": src})
+        assert [f.line for f in found] == [8]
+
+    def test_global_rebind_under_lock_passes(self):
+        src = dedent("""
+            import threading
+
+            _lock = threading.Lock()
+            _conf = None
+
+            def configure(v):
+                global _conf
+                with _lock:
+                    _conf = v
+        """)
+        assert run("lock-global-state",
+                   code={"mosaic_tpu/g.py": src}) == []
+
+
+# ----------------------------------------------------- contract drift
+
+class TestContractRules:
+    def test_unregistered_conf_key_fires(self):
+        src = 'KEY = "mosaic.unknown.key"\n'
+        found = run("contract-conf-key",
+                    code={"mosaic_tpu/config.py": CONFIG_SRC,
+                          "mosaic_tpu/u.py": src})
+        assert len(found) == 1
+        assert "mosaic.unknown.key" in found[0].message
+
+    def test_registered_and_force_prefix_keys_pass(self):
+        src = dedent("""
+            A = "mosaic.known.key"
+            B = "mosaic.planner.force.fusion"
+        """)
+        assert run("contract-conf-key",
+                   code={"mosaic_tpu/config.py": CONFIG_SRC,
+                         "mosaic_tpu/u.py": src}) == []
+
+    def test_conf_docs_both_directions(self):
+        docs = {"docs/usage/conf.md":
+                "Set `mosaic.known.key` or `mosaic.bogus.key`.\n"}
+        found = run("contract-conf-docs",
+                    code={"mosaic_tpu/config.py": CONFIG_SRC},
+                    docs=docs)
+        msgs = " | ".join(f.message for f in found)
+        # registered-but-undocumented anchors at config.py ...
+        assert "mosaic.other.key" in msgs
+        assert any(f.path == "mosaic_tpu/config.py" for f in found)
+        # ... and documented-but-unregistered anchors at the doc
+        assert "mosaic.bogus.key" in msgs
+        assert any(f.path == "docs/usage/conf.md" for f in found)
+
+    def test_conf_docs_family_glob_passes(self):
+        docs = {"docs/usage/conf.md":
+                "All `mosaic.known.key`, `mosaic.other.key` and the "
+                "`mosaic.known.*` family.\n"}
+        assert run("contract-conf-docs",
+                   code={"mosaic_tpu/config.py": CONFIG_SRC},
+                   docs=docs) == []
+
+    def test_bad_metric_name_fires(self):
+        src = dedent("""
+            def probe(metrics, n):
+                metrics.count("BadName")
+                metrics.gauge("fam/Mixed-Case", n)
+        """)
+        found = run("contract-metric-name",
+                    code={"mosaic_tpu/m.py": src})
+        assert len(found) == 2
+
+    def test_good_metric_names_pass(self):
+        src = dedent("""
+            def probe(metrics, dev, n):
+                metrics.count("fam/name")
+                metrics.gauge(f"mem/{dev}/bytes", n)
+        """)
+        assert run("contract-metric-name",
+                   code={"mosaic_tpu/m.py": src}) == []
+
+    def test_undeclared_event_and_dead_entry_fire(self):
+        src = dedent("""
+            from .obs.recorder import recorder
+
+            def go():
+                recorder.record("mystery", x=1)
+                recorder.record("boot")
+        """)
+        found = run("contract-recorder-event",
+                    code={"mosaic_tpu/obs/recorder.py": RECORDER_SRC,
+                          "mosaic_tpu/e.py": src})
+        msgs = " | ".join(f.message for f in found)
+        assert "'mystery'" in msgs      # emitted, not declared
+        assert "'tick'" in msgs         # declared, never emitted
+
+    def test_catalogue_matches_emissions_passes(self):
+        src = dedent("""
+            from .obs.recorder import recorder
+
+            def go():
+                recorder.record("boot")
+                recorder.record("tick")
+        """)
+        assert run("contract-recorder-event",
+                   code={"mosaic_tpu/obs/recorder.py": RECORDER_SRC,
+                         "mosaic_tpu/e.py": src}) == []
+
+    def test_missing_catalogue_is_one_finding(self):
+        found = run("contract-recorder-event",
+                    code={"mosaic_tpu/obs/recorder.py": "x = 1\n"})
+        assert len(found) == 1
+        assert "EVENTS" in found[0].message
+
+    def test_uncovered_fault_site_fires(self):
+        src = dedent("""
+            from .resilience import faults
+
+            def read(path):
+                faults.maybe_fail("thing.read")
+        """)
+        found = run("contract-fault-coverage",
+                    code={"mosaic_tpu/io/thing.py": src},
+                    tests={"tests/test_x.py": "def test_ok(): pass\n"})
+        assert len(found) == 1
+        assert "thing.read" in found[0].message
+
+    def test_fnmatch_covered_site_passes(self):
+        src = dedent("""
+            from .resilience import faults
+
+            def read(path):
+                faults.maybe_fail("thing.read")
+        """)
+        tests = {"tests/test_chaos.py":
+                 'plan("seed=1;site=thing.*,fails=1,error=OSError")\n'}
+        assert run("contract-fault-coverage",
+                   code={"mosaic_tpu/io/thing.py": src},
+                   tests=tests) == []
+
+
+# --------------------------------------------- cancellation coverage
+
+class TestCancelRule:
+    def test_chunk_loop_without_checkpoint_fires(self):
+        src = dedent("""
+            def pump(chunks, consume):
+                for c in chunks:
+                    consume(c)
+        """)
+        found = run("cancel-checkpoint",
+                    code={"mosaic_tpu/perf/pipeline.py": src})
+        assert [f.line for f in found] == [2]
+
+    def test_chunk_loop_with_checkpoint_passes(self):
+        src = dedent("""
+            def pump(chunks, consume, inflight):
+                for c in chunks:
+                    inflight.checkpoint()
+                    consume(c)
+        """)
+        assert run("cancel-checkpoint",
+                   code={"mosaic_tpu/perf/pipeline.py": src}) == []
+
+    def test_chunk_loop_outside_stream_modules_out_of_scope(self):
+        src = dedent("""
+            def pump(chunks, consume):
+                for c in chunks:
+                    consume(c)
+        """)
+        assert run("cancel-checkpoint",
+                   code={"mosaic_tpu/util.py": src}) == []
+
+    def test_operator_boundary_without_checkpoint_fires(self):
+        src = dedent("""
+            def stage(op, rows):
+                return op(rows)
+        """)
+        found = run("cancel-checkpoint",
+                    code={"mosaic_tpu/sql/engine.py": src})
+        assert len(found) == 1
+        assert "stage()" in found[0].message
+
+    def test_operator_boundary_with_checkpoint_passes(self):
+        src = dedent("""
+            def stage(op, rows, handle):
+                handle._checkpoint()
+                return op(rows)
+        """)
+        assert run("cancel-checkpoint",
+                   code={"mosaic_tpu/sql/engine.py": src}) == []
+
+
+# --------------------------------------- suppressions & parse errors
+
+BAD_JIT = "import jax\nf = jax.jit(lambda x: x)"
+
+
+class TestSuppression:
+    def test_same_line_marker(self):
+        src = (BAD_JIT +
+               "  # graftlint: ignore[jit-raw-jit] — test fixture\n")
+        assert run("jit-raw-jit", code={"mosaic_tpu/k.py": src}) == []
+
+    def test_comment_above_marker(self):
+        src = dedent("""
+            import jax
+            # graftlint: ignore[jit-raw-jit] — test fixture
+            f = jax.jit(lambda x: x)
+        """)
+        assert run("jit-raw-jit", code={"mosaic_tpu/k.py": src}) == []
+
+    def test_star_suppresses_any_rule(self):
+        src = BAD_JIT + "  # graftlint: ignore[*] — test fixture\n"
+        assert run("jit-raw-jit", code={"mosaic_tpu/k.py": src}) == []
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        src = (BAD_JIT +
+               "  # graftlint: ignore[jit-host-sync] — wrong id\n")
+        assert len(run("jit-raw-jit",
+                       code={"mosaic_tpu/k.py": src})) == 1
+
+    def test_skip_file(self):
+        src = "# graftlint: skip-file\n" + BAD_JIT + "\n"
+        assert run("jit-raw-jit", code={"mosaic_tpu/k.py": src}) == []
+
+    def test_parse_error_surfaces_as_finding(self):
+        repo = lint.Repo.from_sources(
+            code={"mosaic_tpu/broken.py": "def f(:\n"})
+        found = lint.run_lint(repo)
+        assert [f.rule for f in found] == ["parse-error"]
+        assert "syntax error" in found[0].message
+
+
+# --------------------------------------------------------- baseline
+
+class TestBaseline:
+    def _findings(self):
+        return run("jit-raw-jit", code={"mosaic_tpu/k.py": BAD_JIT})
+
+    def test_round_trip(self, tmp_path):
+        findings = self._findings()
+        assert findings
+        data = lint.baseline_from_findings(
+            findings, reasons={findings[0].key: "legacy kernel"})
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(data))
+        loaded = lint.load_baseline(str(p))
+        new, grandfathered, stale = lint.apply_baseline(findings,
+                                                        loaded)
+        assert new == [] and stale == []
+        assert grandfathered == findings
+
+    def test_stale_entry_reported_when_debt_paid(self, tmp_path):
+        findings = self._findings()
+        data = lint.baseline_from_findings(findings)
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(data))
+        loaded = lint.load_baseline(str(p))
+        new, grandfathered, stale = lint.apply_baseline([], loaded)
+        assert new == [] and grandfathered == []
+        assert stale == [findings[0].key]
+
+    def test_key_survives_line_drift(self, tmp_path):
+        findings = self._findings()
+        data = lint.baseline_from_findings(findings)
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(data))
+        shifted = run("jit-raw-jit",
+                      code={"mosaic_tpu/k.py": "# moved\n" + BAD_JIT})
+        assert shifted[0].line != findings[0].line
+        new, grandfathered, _ = lint.apply_baseline(
+            shifted, lint.load_baseline(str(p)))
+        assert new == [] and grandfathered == shifted
+
+    def test_new_findings_not_absorbed_by_count(self, tmp_path):
+        findings = self._findings()
+        data = lint.baseline_from_findings(findings)
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(data))
+        doubled = run("jit-raw-jit",
+                      code={"mosaic_tpu/k.py":
+                            BAD_JIT + "\ng = jax.jit(lambda y: y)\n"})
+        assert len(doubled) == 2
+        new, grandfathered, _ = lint.apply_baseline(
+            doubled, lint.load_baseline(str(p)))
+        assert len(new) == 1 and len(grandfathered) == 1
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert lint.load_baseline(str(tmp_path / "nope.json")) == {}
+
+    def test_wrong_version_raises(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text('{"version": 99, "findings": {}}')
+        with pytest.raises(ValueError):
+            lint.load_baseline(str(p))
+
+    def test_todo_reason_fills_unexplained_entries(self):
+        data = lint.baseline_from_findings(self._findings())
+        ent = next(iter(data["findings"].values()))
+        assert ent["reason"].startswith("TODO")
+
+
+# -------------------------------------------------------------- CLI
+
+def _cli(args, cwd=REPO_ROOT):
+    return subprocess.run([sys.executable, GRAFTLINT, *args],
+                          cwd=cwd, capture_output=True, text=True)
+
+
+class TestCLI:
+    def _mini_root(self, tmp_path):
+        pkg = tmp_path / "mosaic_tpu"
+        pkg.mkdir()
+        (pkg / "k.py").write_text(BAD_JIT + "\n")
+        return str(tmp_path)
+
+    def test_findings_exit_1_and_json_schema(self, tmp_path):
+        root = self._mini_root(tmp_path)
+        r = _cli(["--root", root, "--json"])
+        assert r.returncode == 1
+        out = json.loads(r.stdout)
+        assert out["version"] == 1
+        assert out["counts"]["new"] == 1
+        f = out["findings"][0]
+        assert set(f) == {"rule", "path", "line", "message"}
+        assert f["rule"] == "jit-raw-jit"
+        assert f["path"] == "mosaic_tpu/k.py"
+
+    def test_update_baseline_then_check_passes(self, tmp_path):
+        root = self._mini_root(tmp_path)
+        r = _cli(["--root", root, "--update-baseline"])
+        assert r.returncode == 0
+        assert "need a reason" in r.stdout     # TODO entries flagged
+        r = _cli(["--root", root, "--check"])
+        assert r.returncode == 0
+
+    def test_check_fails_on_stale_entries(self, tmp_path):
+        root = self._mini_root(tmp_path)
+        assert _cli(["--root", root, "--update-baseline"]).returncode == 0
+        (tmp_path / "mosaic_tpu" / "k.py").write_text("x = 1\n")
+        r = _cli(["--root", root, "--check"])
+        assert r.returncode == 1
+        assert "stale" in r.stdout
+
+    def test_unknown_rule_is_tool_error(self, tmp_path):
+        r = _cli(["--root", self._mini_root(tmp_path),
+                  "--rules", "no-such-rule"])
+        assert r.returncode == 2
+
+    def test_list_rules_names_every_registered_rule(self):
+        r = _cli(["--list-rules"])
+        assert r.returncode == 0
+        for rule in lint.all_rules():
+            assert rule.id in r.stdout
+
+    def test_repo_is_clean_under_committed_baseline(self):
+        """The gate CI runs: the tree + tools/graftlint_baseline.json
+        must lint clean."""
+        r = _cli(["--check"])
+        assert r.returncode == 0, r.stdout + r.stderr

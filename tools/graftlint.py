@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""graftlint CLI: run the mosaic_tpu static-analysis rules.
+
+Usage (from the repo root):
+
+    python tools/graftlint.py --check          # CI gate: exit 0/1
+    python tools/graftlint.py --json           # machine output
+    python tools/graftlint.py --rules jit-raw-jit,lock-unguarded-attr
+    python tools/graftlint.py --list-rules     # rule catalogue
+    python tools/graftlint.py --update-baseline  # rewrite baseline
+
+Exit codes: 0 clean (every finding suppressed or baselined), 1 new
+findings (or stale baseline entries under --check), 2 tool error
+(corrupt baseline, bad arguments).
+
+See docs/usage/linting.md for the rule catalogue and the
+suppression/baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from mosaic_tpu import lint  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=_ROOT,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         "under --root)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: also fail (exit 1) on stale "
+                         "baseline entries")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to cover current "
+                         "findings (reasons carry over; new entries "
+                         "get a TODO reason to fill in)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+
+    if args.list_rules:
+        fam = None
+        for r in sorted(lint.all_rules(),
+                        key=lambda r: (r.family, r.id)):
+            if r.family != fam:
+                fam = r.family
+                print(f"[{fam}]")
+            print(f"  {r.id:28s} {r.doc}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [s.strip() for s in args.rules.split(",")
+                    if s.strip()]
+        known = {r.id for r in lint.all_rules()}
+        bad = sorted(set(rule_ids) - known)
+        if bad:
+            print(f"graftlint: unknown rule(s): {', '.join(bad)}",
+                  file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or os.path.join(args.root,
+                                                  DEFAULT_BASELINE)
+    try:
+        baseline = lint.load_baseline(baseline_path)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    repo = lint.Repo.from_root(args.root)
+    findings = lint.run_lint(repo, rule_ids)
+    new, grandfathered, stale = lint.apply_baseline(findings, baseline)
+
+    if args.update_baseline:
+        data = lint.baseline_from_findings(findings,
+                                           previous=baseline)
+        os.makedirs(os.path.dirname(os.path.abspath(baseline_path)),
+                    exist_ok=True)
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"graftlint: baseline rewritten with "
+              f"{len(data['findings'])} entr"
+              f"{'y' if len(data['findings']) == 1 else 'ies'} "
+              f"-> {baseline_path}")
+        todo = [k for k, v in data["findings"].items()
+                if str(v["reason"]).startswith("TODO")]
+        if todo:
+            print(f"graftlint: {len(todo)} entries need a reason "
+                  "before committing:")
+            for k in todo:
+                print(f"  {k}")
+        return 0
+
+    if args.json:
+        out = {
+            "version": 1,
+            "counts": {"new": len(new),
+                       "baselined": len(grandfathered),
+                       "stale_baseline": len(stale)},
+            "findings": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in grandfathered],
+            "stale_baseline": stale,
+        }
+        json.dump(out, sys.stdout, indent=2)
+        print()
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_baselined:
+            for f in grandfathered:
+                print(f"{f.render()}  [baselined]")
+        for key in stale:
+            print(f"stale baseline entry (debt paid — prune with "
+                  f"--update-baseline): {key}")
+        n, b, s = len(new), len(grandfathered), len(stale)
+        print(f"graftlint: {n} finding{'s' if n != 1 else ''}, "
+              f"{b} baselined, {s} stale baseline "
+              f"entr{'y' if s == 1 else 'ies'}")
+
+    if new:
+        return 1
+    if args.check and stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
