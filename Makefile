@@ -4,11 +4,16 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-rules lint-baseline test
+.PHONY: lint lint-fast lint-rules lint-baseline test
 
 # The CI gate: fail on any new finding OR a stale baseline entry.
 lint:
 	$(PYTHON) tools/graftlint.py --check
+
+# Pre-commit loop: full analysis (graph rules need the whole repo),
+# but only findings anchored in files changed vs HEAD are reported.
+lint-fast:
+	$(PYTHON) tools/graftlint.py --changed
 
 # Print the rule catalogue (docs/usage/linting.md has the prose).
 lint-rules:

@@ -43,7 +43,6 @@ class H3IndexSystem(IndexSystem):
     def __init__(self):
         self._inradius_deg: Dict[int, float] = {}
         self._circum_deg: Dict[int, float] = {}
-        self._sample_fns: Dict[int, object] = {}
         # Cell ids are canonical (Uber H3-compatible): base cells follow
         # the published spec assignment (h3/canonical.py) and pentagon
         # subtrees carry the published K-axis labels, so ids join cleanly
@@ -87,11 +86,14 @@ class H3IndexSystem(IndexSystem):
             import jax
             import jax.numpy as jnp
             from .jaxkernel import latlng_to_cell_jax
-            fn = self._sample_fns.get(res)
-            if fn is None:
-                fn = jax.jit(
-                    lambda la, ln: latlng_to_cell_jax(la, ln, res))
-                self._sample_fns[res] = fn
+            from ....perf.jit_cache import kernel_cache
+            # one kernel per res, shared across H3IndexSystem instances
+            # (the per-instance dict this replaces recompiled per
+            # system object and was invisible to the cache counters)
+            fn = kernel_cache.get_or_build(
+                "h3/sample_cell", (res,),
+                lambda: jax.jit(
+                    lambda la, ln: latlng_to_cell_jax(la, ln, res)))
             n = len(xy)
             if n == 0:
                 return np.empty(0, np.int64)

@@ -35,6 +35,9 @@ from . import rules_jit      # noqa: F401  (registration side effect)
 from . import rules_locks    # noqa: F401
 from . import rules_contracts  # noqa: F401
 from . import rules_cancel   # noqa: F401
+from . import rules_lockorder  # noqa: F401  (graph rules)
+from . import rules_threads  # noqa: F401
+from . import rules_release  # noqa: F401
 
 __all__ = ["Finding", "Module", "Repo", "RULES", "all_rules",
            "run_lint", "load_baseline", "apply_baseline",

@@ -195,6 +195,10 @@ class Repo:
         self.tool_modules: List[Module] = []   # tools/*.py
         self.test_files: List[Tuple[str, str]] = []   # (path, text)
         self.doc_files: List[Tuple[str, str]] = []    # (path, text)
+        self._graph = None                     # memoized RepoGraph
+        #: when set (--changed), findings are only reported for these
+        #: paths, and per-module rules skip walking everything else
+        self.focus_paths: Optional[set] = None
 
     # -- construction
     @classmethod
@@ -261,6 +265,26 @@ class Repo:
                 return m
         return None
 
+    def graph(self):
+        """The whole-repo symbol table / call graph (:mod:`.graph`),
+        built on first use and shared by every graph rule in the run.
+        Lazy import: graph.py imports from core.py.  Never focused —
+        graph rules must always see the whole repo."""
+        if self._graph is None:
+            from .graph import RepoGraph
+            self._graph = RepoGraph(self)
+        return self._graph
+
+    def focused(self, mods: List[Module]) -> List[Module]:
+        """Filter an ANCHOR iteration down to the focus set.  Use for
+        the outer loop a rule emits findings from; collection passes
+        (builder names, conf registry, event catalogue, the graph)
+        must keep scanning everything, or focused runs would lose the
+        cross-file context and invent findings."""
+        if self.focus_paths is None:
+            return mods
+        return [m for m in mods if m.path in self.focus_paths]
+
 
 # ------------------------------------------------------ rule registry
 
@@ -311,6 +335,11 @@ def run_lint(repo: Repo,
                                     mod.suppressed(f.line, f.rule)):
                 continue
             findings.append(f)
+    if repo.focus_paths is not None:
+        # graph rules (and any rule not routed through focused())
+        # report repo-wide; a focused run keeps only findings anchored
+        # in the focus set
+        findings = [f for f in findings if f.path in repo.focus_paths]
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

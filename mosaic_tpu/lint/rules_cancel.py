@@ -84,7 +84,7 @@ def _is_chunk_loop(node: ast.AST) -> bool:
       "boundaries must call the inflight checkpoint (bounded "
       "cancellation latency)")
 def check_cancel_checkpoint(repo: Repo) -> Iterable[Finding]:
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if m.tree is None:
             continue
         if m.path in STREAM_MODULES:

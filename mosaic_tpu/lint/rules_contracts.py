@@ -100,7 +100,7 @@ def check_conf_key(repo: Repo) -> Iterable[Finding]:
     registered, prefix, cfg = _conf_registry(repo)
     if cfg is None:
         return
-    for m in repo.all_code_modules():
+    for m in repo.focused(repo.all_code_modules()):
         if m.tree is None or m.path == CONFIG_MODULE or \
                 m.path.startswith("mosaic_tpu/lint/"):
             continue
@@ -169,7 +169,7 @@ def _metric_segments(arg: ast.AST) -> Optional[List[str]]:
       "metric names are '/'-separated lowercase-snake paths "
       "(family/name) — anything else mangles the OpenMetrics export")
 def check_metric_name(repo: Repo) -> Iterable[Finding]:
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if m.tree is None or m.path.startswith("mosaic_tpu/lint/"):
             continue
         for node in ast.walk(m.tree):
@@ -290,7 +290,7 @@ def check_fault_coverage(repo: Repo) -> Iterable[Finding]:
     if not repo.test_files:
         return
     patterns = _test_site_patterns(repo)
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if m.tree is None or m.path.startswith("mosaic_tpu/lint/") \
                 or m.path == "mosaic_tpu/resilience/faults.py":
             continue

@@ -115,7 +115,7 @@ def _enclosing_fn_names(node: ast.AST, m: Module) -> List[str]:
       "attribution depend on it)")
 def check_raw_jit(repo: Repo) -> Iterable[Finding]:
     builders = _builder_names(repo)
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if not _in_scope(m) or m.path in CHOKE_POINT_MODULES:
             continue
         jit_names = _jit_call_names(m)
@@ -143,7 +143,7 @@ def check_raw_jit(repo: Repo) -> Iterable[Finding]:
       "bare jax.device_put outside perf.pipeline staging bypasses "
       "H2D byte accounting and the memwatch ledger")
 def check_raw_device_put(repo: Repo) -> Iterable[Finding]:
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if not _in_scope(m) or m.path in CHOKE_POINT_MODULES:
             continue
         # functions handed to stream(..., put=...) are staging
@@ -218,7 +218,7 @@ def _jitted_function_nodes(m: Module, jit_names: Set[str]
       "float/int/bool on traced values, np.asarray, "
       ".block_until_ready/.item/.tolist, print, or a conf read")
 def check_host_sync(repo: Repo) -> Iterable[Finding]:
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if not _in_scope(m):
             continue
         jit_names = _jit_call_names(m)

@@ -133,7 +133,7 @@ def _op(op: ast.AST) -> str:
       "a class holding self._lock mutates shared attributes outside "
       "'with self._lock' (race against sampler/worker/HTTP threads)")
 def check_unguarded_attr(repo: Repo) -> Iterable[Finding]:
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if not m.path.startswith("mosaic_tpu/") or m.tree is None:
             continue
         for cls in ast.walk(m.tree):
@@ -185,7 +185,7 @@ def _under_module_lock(node: ast.AST, m: Module, fn: ast.AST,
       "a lock-bearing module rebinds a module global outside "
       "'with <module lock>' (lost updates between conf/env threads)")
 def check_global_state(repo: Repo) -> Iterable[Finding]:
-    for m in repo.modules:
+    for m in repo.focused(repo.modules):
         if not m.path.startswith("mosaic_tpu/") or m.tree is None:
             continue
         locks = _module_locks(m)

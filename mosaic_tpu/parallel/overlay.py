@@ -305,13 +305,17 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
     import jax
     import jax.numpy as jnp
 
+    from ..perf.jit_cache import kernel_cache
+
     if mesh is None:
         def fn(ca, gea, ea, va, cb, geb, eb, vb):
             h, z, dn = _local_sorted_join(ca, gea, ea, va, cb, geb, eb,
                                           vb, ga, gb, dup_cap, eps)
             return h, z, jnp.stack([jnp.int32(0), jnp.int32(0),
                                     dn.astype(jnp.int32)])
-        return jax.jit(fn)
+        return kernel_cache.get_or_build(
+            "overlay/dense", (ga, gb, dup_cap, eps),
+            lambda: jax.jit(fn))
 
     from jax.sharding import PartitionSpec as P
     try:
@@ -338,7 +342,12 @@ def make_overlay_fn(ga: int, gb: int, edge_cap_a: int, edge_cap_b: int,
         in_specs=(P(axis), P(axis), P(axis), P(axis),
                   P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P(), P()))
-    return jax.jit(fn)
+    # id(mesh): same-shaped kernels on different meshes must not alias
+    return kernel_cache.get_or_build(
+        "overlay/dense_sharded",
+        (ga, gb, edge_cap_a, edge_cap_b, id(mesh), axis, bucket_cap,
+         dup_cap, eps),
+        lambda: jax.jit(fn))
 
 
 # ----------------------------------------------------- ragged pair output
@@ -420,6 +429,8 @@ def make_overlay_pairs_fn(row_mult: int, edge_cap_a: int,
     import jax
     import jax.numpy as jnp
 
+    from ..perf.jit_cache import kernel_cache
+
     assert pair_cap > 0
     if mesh is None:
         def fn(ca, ra, ea, va, cb, rb, eb, vb):
@@ -430,7 +441,9 @@ def make_overlay_pairs_fn(row_mult: int, edge_cap_a: int,
                               dn.astype(jnp.int32),
                               ovf.astype(jnp.int32)])
             return keys, count[None], diag
-        return jax.jit(fn)
+        return kernel_cache.get_or_build(
+            "overlay/pairs", (row_mult, dup_cap, pair_cap, eps),
+            lambda: jax.jit(fn))
 
     from jax.sharding import PartitionSpec as P
     try:
@@ -456,7 +469,12 @@ def make_overlay_pairs_fn(row_mult: int, edge_cap_a: int,
         local, mesh=mesh,
         in_specs=(P(axis),) * 8,
         out_specs=(P(axis), P(axis), P()))
-    return jax.jit(fn)
+    # id(mesh): same-shaped kernels on different meshes must not alias
+    return kernel_cache.get_or_build(
+        "overlay/pairs_sharded",
+        (row_mult, edge_cap_a, edge_cap_b, id(mesh), axis, bucket_cap,
+         dup_cap, pair_cap, eps),
+        lambda: jax.jit(fn))
 
 
 def _exchange_rows(cell, row, edges, valid, D: int, axis: str,

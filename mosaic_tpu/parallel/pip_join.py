@@ -689,8 +689,12 @@ def make_planned_pip_join(idx, grid: IndexSystem,
         if key in variants:
             return variants[key]
         if strategy == "monolithic":
-            fn = jax.jit(make_pip_join_fn(idx, grid, eps, margin_eps,
-                                          precision))
+            from ..perf.jit_cache import kernel_cache
+            fn = kernel_cache.get_or_build(
+                "pip/monolithic",
+                (id(idx), id(grid), eps, margin_eps, precision),
+                lambda: jax.jit(make_pip_join_fn(
+                    idx, grid, eps, margin_eps, precision)))
             recheck = host_recheck_fn(idx, polys)
             origin = np.asarray(idx.origin)
 

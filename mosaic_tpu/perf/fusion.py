@@ -653,8 +653,12 @@ def execute_group(group: FusionGroup, q: Query, env,
         f"fusion/{group.name}",
         sum(int(getattr(o, "nbytes", 0)) for o in dev_out),
         devices=device_keys_of(dev_out)) if memwatch.enabled else None
-    host = list(jax.device_get(dev_out))      # the ONE group fetch
-    memwatch.release(mem_tok)
+    try:
+        host = list(jax.device_get(dev_out))  # the ONE group fetch
+    finally:
+        # a fetch unwinding (cancel mid-device_get, chaos fault) must
+        # still drain the span — a stranded token reads as a leak
+        memwatch.release(mem_tok)
     wall = time.perf_counter() - t0
     d2h = sum(int(h.nbytes) for h in host)
     if metrics.enabled:

@@ -152,12 +152,17 @@ def stream(chunks: Sequence, compute: Callable,
                  "shrunk": False}
 
     def fetch(i, payload, out, dispatch_t, tok_in, tok_out):
-        faults.maybe_fail("pipeline.fetch")
-        host = _to_host(out)        # blocks the WORKER until ready
-        # the chunk's device buffers are drained: input consumed by the
-        # launch, output copied out — both leave the ledger here
-        memwatch.release(tok_out)
-        memwatch.release(tok_in)
+        try:
+            faults.maybe_fail("pipeline.fetch")
+            host = _to_host(out)    # blocks the WORKER until ready
+        finally:
+            # the chunk's device buffers are drained — input consumed
+            # by the launch, output copied out — and both must leave
+            # the ledger even when the fetch itself unwinds (fault,
+            # cancel): a raise above this line used to strand both
+            # tokens until the query-complete sentinel swept them
+            memwatch.release(tok_out)
+            memwatch.release(tok_in)
         if observe is not None:     # single worker: in-order, race-free
             now = _time.perf_counter()
             start = max(dispatch_t, obs_state["last_done"])

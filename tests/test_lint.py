@@ -561,3 +561,570 @@ class TestCLI:
         must lint clean."""
         r = _cli(["--check"])
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------ interprocedural: graph
+
+def graph_of(code):
+    return lint.Repo.from_sources(code=code).graph()
+
+
+class TestRepoGraph:
+    def test_method_and_cross_module_calls_resolve(self):
+        util = dedent("""
+            def helper():
+                return 1
+        """)
+        eng = dedent("""
+            from .util import helper
+
+            class Engine:
+                def run(self):
+                    return self._step()
+
+                def _step(self):
+                    return helper()
+        """)
+        g = graph_of({"mosaic_tpu/util.py": util,
+                      "mosaic_tpu/engine.py": eng})
+        assert "mosaic_tpu/engine.py::Engine._step" in {
+            e.callee for e in g.edges_from(
+                "mosaic_tpu/engine.py::Engine.run")}
+        assert "mosaic_tpu/util.py::helper" in {
+            e.callee for e in g.edges_from(
+                "mosaic_tpu/engine.py::Engine._step")}
+
+    def test_builder_by_name_edge(self):
+        src = dedent("""
+            from .perf.jit_cache import kernel_cache
+
+            def _build():
+                return 1
+
+            def kernel(key):
+                return kernel_cache.get_or_build("k", key, _build)
+        """)
+        g = graph_of({"mosaic_tpu/k.py": src})
+        assert "mosaic_tpu/k.py::_build" in {
+            e.callee for e in g.edges_from("mosaic_tpu/k.py::kernel")}
+
+    def test_singleton_instance_method_resolves(self):
+        a = dedent("""
+            class Thing:
+                def poke(self):
+                    return 1
+
+            thing = Thing()
+        """)
+        b = dedent("""
+            from .a import thing
+
+            def go():
+                thing.poke()
+        """)
+        g = graph_of({"mosaic_tpu/a.py": a, "mosaic_tpu/b.py": b})
+        assert "mosaic_tpu/a.py::Thing.poke" in {
+            e.callee for e in g.edges_from("mosaic_tpu/b.py::go")}
+
+    def test_thread_edges_and_arg_offset(self):
+        src = dedent("""
+            import threading
+
+            def _work():
+                pass
+
+            def _job(tok):
+                pass
+
+            def go(pool, tok):
+                threading.Thread(target=_work).start()
+                pool.submit(_job, tok)
+        """)
+        g = graph_of({"mosaic_tpu/t.py": src})
+        by_callee = {e.callee: e for e in g.thread_edges()}
+        assert by_callee["mosaic_tpu/t.py::_work"].arg_offset == 0
+        assert by_callee["mosaic_tpu/t.py::_job"].arg_offset == 1
+
+    def test_lock_closure_is_transitive(self):
+        src = dedent("""
+            import threading
+
+            _lock = threading.Lock()
+
+            def inner():
+                with _lock:
+                    pass
+
+            def outer():
+                inner()
+        """)
+        g = graph_of({"mosaic_tpu/x.py": src})
+        clo = g.lock_closure()
+        assert "mosaic_tpu/x.py::_lock" in clo["mosaic_tpu/x.py::outer"]
+
+
+# ------------------------------------------------ lock-order family
+
+class TestLockOrderRules:
+    BAD_CYCLE = dedent("""
+        import threading
+
+        _lock_a = threading.Lock()
+        _lock_b = threading.Lock()
+
+        def _take_b():
+            with _lock_b:
+                pass
+
+        def ab():
+            with _lock_a:
+                _take_b()
+
+        def ba():
+            with _lock_b:
+                with _lock_a:
+                    pass
+    """)
+
+    def test_ab_ba_cycle_fires_per_edge(self):
+        found = run("lock-order-cycle",
+                    code={"mosaic_tpu/x.py": self.BAD_CYCLE})
+        assert len(found) == 2
+        msgs = " | ".join(f.message for f in found)
+        assert "_lock_a" in msgs and "_lock_b" in msgs
+        assert "via" in msgs            # call-chain evidence on ab
+
+    def test_consistent_order_passes(self):
+        src = dedent("""
+            import threading
+
+            _lock_a = threading.Lock()
+            _lock_b = threading.Lock()
+
+            def ab():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+
+            def also_ab():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+        """)
+        assert run("lock-order-cycle",
+                   code={"mosaic_tpu/x.py": src}) == []
+
+    def test_reentrant_call_through_callee_fires(self):
+        src = dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def _bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def bump_twice(self):
+                    with self._lock:
+                        self._bump()
+        """)
+        found = run("lock-reentrant-call",
+                    code={"mosaic_tpu/b.py": src})
+        assert len(found) == 1
+        assert "_bump" in found[0].message
+
+    def test_rlock_reentry_exempt(self):
+        src = dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self.n = 0
+
+                def _bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def bump_twice(self):
+                    with self._lock:
+                        self._bump()
+        """)
+        assert run("lock-reentrant-call",
+                   code={"mosaic_tpu/b.py": src}) == []
+
+    def test_lexical_reentry_fires(self):
+        src = dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def oops(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        found = run("lock-reentrant-call",
+                    code={"mosaic_tpu/b.py": src})
+        assert len(found) == 1
+        assert "re-enters" in found[0].message
+
+
+# ----------------------------------------------------- thread escape
+
+class TestThreadEscapeRule:
+    def test_unguarded_mutation_on_thread_fires(self):
+        src = dedent("""
+            import threading
+
+            class Sampler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+                def start(self):
+                    def _work():
+                        self.rows.append(1)
+                    threading.Thread(target=_work).start()
+        """)
+        found = run("thread-escape-unguarded",
+                    code={"mosaic_tpu/s.py": src})
+        assert len(found) == 1
+        assert "self.rows" in found[0].message
+        assert "Sampler" in found[0].message
+
+    def test_locked_mutation_on_thread_passes(self):
+        src = dedent("""
+            import threading
+
+            class Sampler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+                def start(self):
+                    def _work():
+                        with self._lock:
+                            self.rows.append(1)
+                    threading.Thread(target=_work).start()
+        """)
+        assert run("thread-escape-unguarded",
+                   code={"mosaic_tpu/s.py": src}) == []
+
+    def test_bound_method_target_is_other_rules_jurisdiction(self):
+        # lock-unguarded-attr already covers method bodies; the thread
+        # rule must not double-report them
+        src = dedent("""
+            import threading
+
+            class Sampler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+                def _drain(self):
+                    self.rows.append(1)
+
+                def start(self):
+                    threading.Thread(target=self._drain).start()
+        """)
+        assert run("thread-escape-unguarded",
+                   code={"mosaic_tpu/s.py": src}) == []
+
+
+# --------------------------------------------------- release pairing
+
+MEMWATCH_SRC = dedent("""
+    class DeviceMemoryLedger:
+        def register(self, site, nbytes):
+            return object()
+
+        def release(self, token):
+            return None
+
+    memwatch = DeviceMemoryLedger()
+""")
+
+
+class TestReleasePathRule:
+    def _run(self, client):
+        return run("resource-release-path",
+                   code={"mosaic_tpu/obs/memwatch.py": MEMWATCH_SRC,
+                         "mosaic_tpu/stage.py": client})
+
+    def test_raise_before_release_fires(self):
+        src = dedent("""
+            from .obs.memwatch import memwatch
+
+            def stage(buf, work):
+                tok = memwatch.register("stage", 8)
+                work(buf)
+                memwatch.release(tok)
+        """)
+        found = self._run(src)
+        assert len(found) == 1
+        assert "'tok'" in found[0].message
+
+    def test_finally_twin_passes(self):
+        src = dedent("""
+            from .obs.memwatch import memwatch
+
+            def stage(buf, work):
+                tok = memwatch.register("stage", 8)
+                try:
+                    work(buf)
+                finally:
+                    memwatch.release(tok)
+        """)
+        assert self._run(src) == []
+
+    def test_discarded_token_fires(self):
+        src = dedent("""
+            from .obs.memwatch import memwatch
+
+            def stage():
+                memwatch.register("stage", 8)
+        """)
+        found = self._run(src)
+        assert len(found) == 1
+        assert "discarded" in found[0].message
+
+    def test_returned_token_escapes_passes(self):
+        src = dedent("""
+            from .obs.memwatch import memwatch
+
+            def stage():
+                return memwatch.register("stage", 8)
+        """)
+        assert self._run(src) == []
+
+    def test_thread_handoff_to_finally_worker_passes(self):
+        src = dedent("""
+            from .obs.memwatch import memwatch
+
+            def _worker(tok):
+                try:
+                    consume(tok)
+                finally:
+                    memwatch.release(tok)
+
+            def go(pool):
+                tok = memwatch.register("s", 8)
+                pool.submit(_worker, tok)
+        """)
+        assert self._run(src) == []
+
+    def test_thread_handoff_to_unprotected_worker_fires(self):
+        src = dedent("""
+            from .obs.memwatch import memwatch
+
+            def _worker(tok):
+                consume(tok)
+                memwatch.release(tok)
+
+            def go(pool):
+                tok = memwatch.register("s", 8)
+                pool.submit(_worker, tok)
+        """)
+        found = self._run(src)
+        assert len(found) == 1
+        assert "thread worker" in found[0].message
+
+    def test_other_ledgers_named_register_ignored(self):
+        src = dedent("""
+            class KernelLedger:
+                def register(self, k, v):
+                    return object()
+
+            ledger = KernelLedger()
+
+            def note(k, v):
+                ledger.register(k, v)
+        """)
+        assert self._run(src) == []
+
+
+# ----------------------------------------------- CLI: changed, sarif
+
+class TestCLIChangedAndSarif:
+    def _git(self, *args, cwd):
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", *args],
+                       cwd=cwd, check=True, capture_output=True)
+
+    def test_changed_scopes_report_to_diff(self, tmp_path):
+        pkg = tmp_path / "mosaic_tpu"
+        pkg.mkdir()
+        (pkg / "old.py").write_text(BAD_JIT + "\n")
+        self._git("init", "-q", cwd=tmp_path)
+        self._git("add", "-A", cwd=tmp_path)
+        self._git("commit", "-qm", "seed", cwd=tmp_path)
+        # clean tree: the committed debt is not the diff's problem
+        r = _cli(["--root", str(tmp_path), "--changed", "--json"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert json.loads(r.stdout)["counts"]["new"] == 0
+        # a new bad file is reported; the committed bad one stays out
+        (pkg / "new.py").write_text(BAD_JIT + "\n")
+        r = _cli(["--root", str(tmp_path), "--changed", "--json"])
+        assert r.returncode == 1
+        out = json.loads(r.stdout)
+        assert {f["path"] for f in out["findings"]} == \
+            {"mosaic_tpu/new.py"}
+
+    def test_changed_without_git_falls_back_to_full(self, tmp_path):
+        pkg = tmp_path / "mosaic_tpu"
+        pkg.mkdir()
+        (pkg / "k.py").write_text(BAD_JIT + "\n")
+        r = _cli(["--root", str(tmp_path), "--changed", "--json"])
+        assert r.returncode == 1
+        assert "full repo" in r.stderr
+        assert json.loads(r.stdout)["counts"]["new"] == 1
+
+    def test_sarif_output_schema(self, tmp_path):
+        pkg = tmp_path / "mosaic_tpu"
+        pkg.mkdir()
+        (pkg / "k.py").write_text(BAD_JIT + "\n")
+        sarif = tmp_path / "out.sarif"
+        r = _cli(["--root", str(tmp_path), "--sarif", str(sarif),
+                  "--json"])
+        assert r.returncode == 1
+        doc = json.loads(sarif.read_text())
+        assert doc["version"] == "2.1.0"
+        drv = doc["runs"][0]["tool"]["driver"]
+        assert drv["name"] == "graftlint"
+        assert any(rd["id"] == "jit-raw-jit" for rd in drv["rules"])
+        res = doc["runs"][0]["results"][0]
+        assert res["ruleId"] == "jit-raw-jit"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "mosaic_tpu/k.py"
+        assert loc["region"]["startLine"] >= 1
+
+
+# ------------------------------------------- non-vacuity meta-gate
+
+# One known-bad fixture per registered rule.  CI runs the test below
+# on its own (`-k every_rule_fires`): a rule that cannot fail
+# certifies nothing, and registering a rule without adding its bad
+# fixture here fails the gate.
+_RULE_BAD_FIXTURES = {
+    "jit-raw-jit": dict(code={"mosaic_tpu/k.py": BAD_JIT + "\n"}),
+    "jit-raw-device-put": dict(code={"mosaic_tpu/k.py": dedent("""
+        import jax
+
+        def stage(chunk):
+            return jax.device_put(chunk)
+    """)}),
+    "jit-host-sync": dict(code={"mosaic_tpu/k.py": dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + 1.0
+    """)}),
+    "lock-unguarded-attr": dict(code={"mosaic_tpu/c.py": dedent("""
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+    """)}),
+    "lock-global-state": dict(code={"mosaic_tpu/g.py": dedent("""
+        import threading
+
+        _lock = threading.Lock()
+        _conf = None
+
+        def configure(v):
+            global _conf
+            _conf = v
+    """)}),
+    "contract-conf-key": dict(
+        code={"mosaic_tpu/config.py": CONFIG_SRC,
+              "mosaic_tpu/u.py": 'KEY = "mosaic.unknown.key"\n'}),
+    "contract-conf-docs": dict(
+        code={"mosaic_tpu/config.py": CONFIG_SRC},
+        docs={"docs/usage/conf.md": "Set `mosaic.bogus.key`.\n"}),
+    "contract-metric-name": dict(code={"mosaic_tpu/m.py": dedent("""
+        def probe(metrics):
+            metrics.count("BadName")
+    """)}),
+    "contract-recorder-event": dict(
+        code={"mosaic_tpu/obs/recorder.py": RECORDER_SRC,
+              "mosaic_tpu/e.py": dedent("""
+        from .obs.recorder import recorder
+
+        def go():
+            recorder.record("mystery")
+    """)}),
+    "contract-fault-coverage": dict(
+        code={"mosaic_tpu/io/thing.py": dedent("""
+            from .resilience import faults
+
+            def read(path):
+                faults.maybe_fail("thing.read")
+        """)},
+        tests={"tests/test_x.py": "def test_ok(): pass\n"}),
+    "cancel-checkpoint": dict(
+        code={"mosaic_tpu/perf/pipeline.py": dedent("""
+            def pump(chunks, consume):
+                for c in chunks:
+                    consume(c)
+        """)}),
+    "lock-order-cycle": dict(
+        code={"mosaic_tpu/x.py": TestLockOrderRules.BAD_CYCLE}),
+    "lock-reentrant-call": dict(code={"mosaic_tpu/b.py": dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def oops(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)}),
+    "thread-escape-unguarded": dict(code={"mosaic_tpu/s.py": dedent("""
+        import threading
+
+        class Sampler:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.rows = []
+
+            def start(self):
+                def _work():
+                    self.rows.append(1)
+                threading.Thread(target=_work).start()
+    """)}),
+    "resource-release-path": dict(
+        code={"mosaic_tpu/obs/memwatch.py": MEMWATCH_SRC,
+              "mosaic_tpu/stage.py": dedent("""
+        from .obs.memwatch import memwatch
+
+        def stage(buf, work):
+            tok = memwatch.register("stage", 8)
+            work(buf)
+            memwatch.release(tok)
+    """)}),
+}
+
+
+def test_every_rule_fires_on_its_bad_fixture():
+    missing = [r.id for r in lint.all_rules()
+               if r.id not in _RULE_BAD_FIXTURES]
+    assert not missing, f"rules with no bad fixture: {missing}"
+    unknown = set(_RULE_BAD_FIXTURES) - {r.id for r in
+                                         lint.all_rules()}
+    assert not unknown, f"fixtures for unregistered rules: {unknown}"
+    for rid, kw in sorted(_RULE_BAD_FIXTURES.items()):
+        assert run(rid, **kw), f"rule {rid} did not fire (vacuous)"

@@ -199,6 +199,36 @@ def test_no_recompile_on_second_identical_run(grid):
             tracer.disable()
 
 
+def test_migrated_kernels_warm_zero_compiles():
+    """The three pre-kernel_cache holdouts (overlay kernels, H3
+    candidate-sampling kernel, monolithic PIP) now build through
+    get_or_build: an identical second build must be a cache hit with
+    zero new misses, so warm runs stay at zero compiles."""
+    from mosaic_tpu.core.index.h3.system import H3IndexSystem
+    from mosaic_tpu.parallel.overlay import (make_overlay_fn,
+                                             make_overlay_pairs_fn)
+    kernel_cache.clear()
+    s0 = kernel_cache.stats()           # counters are cumulative:
+    make_overlay_fn(4, 4, 8, 8)         # use deltas
+    make_overlay_pairs_fn(1024, 8, 8, pair_cap=16)
+    s1 = kernel_cache.stats()
+    assert s1["misses"] - s0["misses"] == 2
+    make_overlay_fn(4, 4, 8, 8)              # identical rebuilds: hits
+    make_overlay_pairs_fn(1024, 8, 8, pair_cap=16)
+    s2 = kernel_cache.stats()
+    assert s2["misses"] == s1["misses"], "overlay kernel rebuilt warm"
+    assert s2["hits"] - s1["hits"] == 2
+    # the H3 sampling kernel shares one entry per res across index
+    # instances (pre-migration it lived in a per-instance dict, so a
+    # fresh H3IndexSystem recompiled and the cache counters were blind)
+    xy = np.random.default_rng(0).uniform(-40, 40, (40_000, 2))
+    H3IndexSystem()._point_to_cell_sample(xy, 5)
+    m1 = kernel_cache.stats()["misses"]
+    H3IndexSystem()._point_to_cell_sample(xy, 5)   # fresh instance
+    assert kernel_cache.stats()["misses"] == m1, \
+        "H3 sample kernel recompiled per instance"
+
+
 # ---------------------------------------------------------- pipeline
 
 def test_chunk_rows():

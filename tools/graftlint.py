@@ -6,6 +6,9 @@ Usage (from the repo root):
     python tools/graftlint.py --check          # CI gate: exit 0/1
     python tools/graftlint.py --json           # machine output
     python tools/graftlint.py --rules jit-raw-jit,lock-unguarded-attr
+    python tools/graftlint.py --changed        # findings in the diff
+    python tools/graftlint.py --changed origin/main   # ...vs a ref
+    python tools/graftlint.py --sarif out.sarif  # PR-annotation output
     python tools/graftlint.py --list-rules     # rule catalogue
     python tools/graftlint.py --update-baseline  # rewrite baseline
 
@@ -22,13 +25,32 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
 
-from mosaic_tpu import lint  # noqa: E402
+
+def _import_lint():
+    """Load mosaic_tpu.lint WITHOUT importing mosaic_tpu: the package
+    __init__ pulls jax (~0.4 s), which the pure-stdlib linter never
+    touches — skipping it keeps ``--changed`` pre-commit runs inside
+    their latency budget.  The lint package only uses relative imports
+    internally, so it loads cleanly under a private name."""
+    import importlib.util
+    pkg = os.path.join(_ROOT, "mosaic_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "_graftlint_rules", os.path.join(pkg, "__init__.py"),
+        submodule_search_locations=[pkg])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_graftlint_rules"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint = _import_lint()
 
 DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
 
@@ -58,7 +80,78 @@ def _parse_args(argv):
                          "get a TODO reason to fill in)")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print grandfathered findings")
+    ap.add_argument("--changed", nargs="?", const="HEAD",
+                    default=None, metavar="REF",
+                    help="report only findings anchored in files "
+                         "changed vs REF (default HEAD: working-tree "
+                         "diff + untracked).  Every rule still sees "
+                         "the whole repo — graph and cross-file rules "
+                         "need it — so this scopes the REPORT, not "
+                         "the analysis; stale-baseline noise is "
+                         "suppressed")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 to PATH "
+                         "(CI uploads it so findings annotate the PR "
+                         "diff)")
     return ap.parse_args(argv)
+
+
+def _changed_paths(root: str, ref: str):
+    """Repo-relative paths changed vs ``ref`` plus untracked files;
+    None when git is unavailable (caller falls back to a full
+    report)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        extra = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    paths = set()
+    for out in (diff.stdout, extra.stdout if extra.returncode == 0
+                else ""):
+        paths.update(p.strip() for p in out.splitlines() if p.strip())
+    return paths
+
+
+def _sarif(findings, rules) -> dict:
+    """Minimal SARIF 2.1.0: one run, one result per NEW finding —
+    enough for GitHub code-scanning upload to pin findings to diff
+    lines."""
+    by_id = {r.id: r for r in rules}
+    used = sorted({f.rule for f in findings})
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                    ".json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/usage/linting.md",
+                "rules": [{
+                    "id": rid,
+                    "shortDescription": {"text": by_id[rid].doc
+                                         if rid in by_id else rid},
+                } for rid in used],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(1, f.line)},
+                    },
+                }],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -94,8 +187,27 @@ def main(argv=None) -> int:
         return 2
 
     repo = lint.Repo.from_root(args.root)
+    changed = None
+    if args.changed is not None:
+        changed = _changed_paths(args.root, args.changed)
+        if changed is not None:
+            # graph and cross-file collection passes still see the
+            # whole repo; per-module walks and the REPORT are scoped
+            # to the diff.  Stale entries are a full-run concern, not
+            # a pre-commit one.
+            repo.focus_paths = changed
+        else:
+            print("graftlint: --changed: git diff failed; reporting "
+                  "the full repo", file=sys.stderr)
     findings = lint.run_lint(repo, rule_ids)
     new, grandfathered, stale = lint.apply_baseline(findings, baseline)
+    if changed is not None:
+        stale = []
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(_sarif(new, lint.all_rules()), fh, indent=2)
+            fh.write("\n")
 
     if args.update_baseline:
         data = lint.baseline_from_findings(findings,
