@@ -210,6 +210,7 @@ def perf_guard(current: dict, platform: str, slip: float = 0.20,
     tags = "+".join(tag for tag, _ in hist)
     lower_better = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
                     "planner_flagship_ms", "fused_flagship_ms",
+                    "serving_p95_ms",
                     "sharded_end_to_end_ms",
                     "tessellate_zones_s",
                     "tessellate_counties_s", "overlay_s",
@@ -774,6 +775,40 @@ def main():
         f"vs unfused {unfused_ms:.2f} ms "
         f"({unfused_ms / fused_ms:.2f}x); project fused "
         f"{pf_ms:.2f} ms vs {pu_ms:.2f} ms; parity 0; warm compiles 0")
+
+    # ---- serving: the multi-tenant query frontend under load ------
+    # Boot the real server over the same warm session and drive it
+    # with the loadtest's closed-loop clients: 8 concurrent clients,
+    # two tenants, the flagship aggregate + a micro-batchable point
+    # lookup in the mix.  serving_p95_ms (client-observed) joins the
+    # perf guard; the deadline curve records where overload begins.
+    from mosaic_tpu.serve import QueryServer as _QServer
+    from tools.loadtest import deadline_curve, run_loadtest
+    _fsess.create_table("spts", {
+        "lon": _frng.uniform(-170.0, 170.0, size=4_096),
+        "lat": _frng.uniform(-80.0, 80.0, size=4_096)})
+    _serve_dur = 1.5 if smoke else 4.0
+    with tracer.span("bench/serving"), \
+            _QServer(_fsess, workers=4) as _qs:
+        serving_rep = run_loadtest(
+            "127.0.0.1", _qs.port,
+            [(_FQ, 2.0),
+             ("SELECT grid_longlatascellid(lon, lat, 5) AS c "
+              "FROM spts", 1.0)],
+            clients=8, duration_s=_serve_dur,
+            principals=["bench-a", "bench-b"])
+        serving_rep["deadline_curve"] = deadline_curve(
+            "127.0.0.1", _qs.port, _FQ, deadline_ms=1_000.0,
+            qps_levels=(5, 20) if smoke else (5, 20, 60),
+            duration_s=1.0 if smoke else 2.0)
+        serving_rep["server"] = _qs.stats()
+    assert serving_rep["outcomes"].get("error", 0) == 0, \
+        f"serving bench saw errors: {serving_rep['outcomes']}"
+    record_serving_p95 = serving_rep["latency_ms"]["p95"]
+    log(f"serving: {serving_rep['qps']} req/s over 8 clients, "
+        f"p95 {record_serving_p95:.1f} ms, outcomes "
+        f"{serving_rep['outcomes']}")
+    _fsess.drop_table("spts")
     _fsess.drop_table("fpts")
 
     obs_rep = tracer.report()
@@ -817,6 +852,12 @@ def main():
         # perf guard
         "fusion": fusion_rec,
         "fused_flagship_ms": fusion_rec["fused_flagship_ms"],
+        # query-server loadtest (serve/ + tools/loadtest.py):
+        # client-observed percentiles, per-tenant outcomes, and the
+        # QPS-vs-deadline-miss curve; serving_p95_ms joins the guard
+        "serving": serving_rep,
+        "serving_p95_ms": round(record_serving_p95, 2)
+        if record_serving_p95 else None,
         "multichip": {
             "n_devices": len(devs),
             "rc": 0,

@@ -116,6 +116,27 @@ MOSAIC_AUDIT_PATH = "mosaic.audit.path"
 MOSAIC_MEM_BUDGET_BYTES = "mosaic.mem.budget.bytes"
 MOSAIC_MEM_PRESSURE_HIGH = "mosaic.mem.pressure.high"
 MOSAIC_OBS_MEM_ENABLED = "mosaic.obs.mem.enabled"
+# Multi-tenant query service (mosaic_tpu/serve/): listen port (0 =
+# ephemeral, the test/loadtest default), worker-thread count, bounded
+# admission-queue depth, per-principal quotas (concurrent queries
+# queued+running, admissions/second over a 1s sliding window; 0
+# disables either quota), a default per-request deadline (0 = none;
+# the X-Mosaic-Deadline-Ms header overrides per request), the
+# drain-on-SIGTERM grace period, and the micro-batcher's knobs: how
+# long a worker waits for more compatible point lookups, the most
+# queries one device launch may coalesce (0 disables batching — every
+# query runs through SQLSession.sql), and the largest per-query row
+# count still classified as batchable (sql/engine.classify_batchable).
+MOSAIC_SERVE_PORT = "mosaic.serve.port"
+MOSAIC_SERVE_WORKERS = "mosaic.serve.workers"
+MOSAIC_SERVE_QUEUE_DEPTH = "mosaic.serve.queue.depth"
+MOSAIC_SERVE_QUOTA_CONCURRENCY = "mosaic.serve.quota.concurrency"
+MOSAIC_SERVE_QUOTA_QPS = "mosaic.serve.quota.qps"
+MOSAIC_SERVE_DEADLINE_MS = "mosaic.serve.deadline.ms"
+MOSAIC_SERVE_DRAIN_MS = "mosaic.serve.drain.ms"
+MOSAIC_SERVE_BATCH_WINDOW_MS = "mosaic.serve.batch.window.ms"
+MOSAIC_SERVE_BATCH_MAX = "mosaic.serve.batch.max"
+MOSAIC_SERVE_BATCH_ROWS_MAX = "mosaic.serve.batch.rows.max"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -223,6 +244,18 @@ class MosaicConfig:
     # Device-memory ledger master switch (register/release tracking,
     # per-query attribution, leak sentinel).
     obs_mem_enabled: bool = True
+    # Query service (mosaic_tpu/serve/) — see the mosaic.serve.* key
+    # comments above for semantics.
+    serve_port: int = 0
+    serve_workers: int = 4
+    serve_queue_depth: int = 64
+    serve_quota_concurrency: int = 8
+    serve_quota_qps: float = 0.0
+    serve_deadline_ms: float = 0.0
+    serve_drain_ms: float = 5_000.0
+    serve_batch_window_ms: float = 2.0
+    serve_batch_max: int = 32
+    serve_batch_rows_max: int = 4_096
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -326,6 +359,29 @@ def _as_str(key: str, value) -> str:
     return str(value)
 
 
+def _as_count(key: str, value) -> int:
+    try:
+        n = int(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not an integer") from None
+    if n < 0:
+        raise ConfigError(f"{key}={n} must be >= 0 (0 disables)")
+    return n
+
+
+def _as_port(key: str, value) -> int:
+    try:
+        n = int(str(value).strip())
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"{key}={value!r} is not a port number") from None
+    if not 0 <= n <= 65535:
+        raise ConfigError(f"{key}={n} must be in [0, 65535] "
+                          "(0 = ephemeral)")
+    return n
+
+
 def _as_knn_strategy(key: str, value) -> str:
     s = str(value).strip().lower()
     if s in ("auto", "brute", "ring"):
@@ -375,6 +431,18 @@ _CONF_FIELDS = {
     MOSAIC_MEM_BUDGET_BYTES: ("mem_budget_bytes", _as_bytes),
     MOSAIC_MEM_PRESSURE_HIGH: ("mem_pressure_high", _as_fraction),
     MOSAIC_OBS_MEM_ENABLED: ("obs_mem_enabled", _as_flag),
+    MOSAIC_SERVE_PORT: ("serve_port", _as_port),
+    MOSAIC_SERVE_WORKERS: ("serve_workers", _as_blocksize),
+    MOSAIC_SERVE_QUEUE_DEPTH: ("serve_queue_depth", _as_blocksize),
+    MOSAIC_SERVE_QUOTA_CONCURRENCY: ("serve_quota_concurrency",
+                                     _as_count),
+    MOSAIC_SERVE_QUOTA_QPS: ("serve_quota_qps", _as_hz),
+    MOSAIC_SERVE_DEADLINE_MS: ("serve_deadline_ms", _as_millis),
+    MOSAIC_SERVE_DRAIN_MS: ("serve_drain_ms", _as_millis),
+    MOSAIC_SERVE_BATCH_WINDOW_MS: ("serve_batch_window_ms", _as_millis),
+    MOSAIC_SERVE_BATCH_MAX: ("serve_batch_max", _as_count),
+    MOSAIC_SERVE_BATCH_ROWS_MAX: ("serve_batch_rows_max",
+                                  _as_blocksize),
 }
 
 

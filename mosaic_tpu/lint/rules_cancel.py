@@ -32,6 +32,7 @@ STREAM_MODULES = {
     "mosaic_tpu/parallel/pip_join.py",
     "mosaic_tpu/sql/engine.py",
     "mosaic_tpu/perf/fusion.py",
+    "mosaic_tpu/serve/batching.py",
 }
 
 #: (module, function) pairs that ARE an operator boundary: each must
@@ -39,6 +40,9 @@ STREAM_MODULES = {
 BOUNDARY_FUNCS = {
     ("mosaic_tpu/sql/engine.py", "stage"),
     ("mosaic_tpu/perf/fusion.py", "execute_group"),
+    # the query server's per-request loop: a request popped off the
+    # admission queue passes through dispatch() before any work runs
+    ("mosaic_tpu/serve/workers.py", "dispatch"),
 }
 
 _CHECKPOINT_NAMES = {"checkpoint", "_checkpoint"}

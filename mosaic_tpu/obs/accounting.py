@@ -130,6 +130,17 @@ class PrincipalMeter:
                 for p, tot in self._totals.items()
             }
 
+    def mean_wall_ms(self, principal: str) -> Optional[float]:
+        """The principal's observed mean query latency, or None before
+        its first completed query.  The admission queue's Retry-After
+        hint (serve/admission.py): a tenant running heavy queries is
+        told to back off for about one of its own query times."""
+        with self._lock:
+            tot = self._totals.get(principal)
+            if not tot or not tot.get("queries"):
+                return None
+            return float(tot["wall_ms"]) / float(tot["queries"])
+
     def reset(self) -> None:
         with self._lock:
             self._totals.clear()

@@ -27,6 +27,8 @@ Routes:
 * ``/api/queries``      — live query console: in-flight tickets
   (``obs.inflight``) + recent audit completions (``?limit=``)
 * ``/api/principals``   — per-principal meter totals (``obs.accounting``)
+* ``/api/server``       — query-server state (``serve/``): queue,
+  quotas, per-tenant admission/shed counters
 * ``POST /api/queries/<id>/cancel`` — request cooperative cancellation
   of an in-flight query (POST-only: GET answers 405; an unknown id
   answers a JSON 404)
@@ -180,6 +182,24 @@ def _principals_payload() -> Dict[str, object]:
     return {"principals": meter.report()}
 
 
+def _server_payload() -> Dict[str, object]:
+    """The query-server panel: the live :class:`~..serve.server.
+    QueryServer`'s stats, or ``{"running": False}`` when no server is
+    up in this process (the dashboard works stand-alone)."""
+    try:
+        from ..serve.server import current_server
+    except Exception:
+        return {"running": False}
+    srv = current_server()
+    if srv is None:
+        return {"running": False}
+    try:
+        return srv.stats()
+    except Exception as exc:
+        return {"running": True,
+                "error": f"{type(exc).__name__}: {exc}"}
+
+
 def _profile_payload(qs: Dict[str, list]) -> Dict[str, object]:
     from .profiler import ledger, profiler
     trace = (qs.get("trace") or [None])[0] or None
@@ -226,6 +246,8 @@ _PAGE = """<!doctype html>
 <h2>Queries in flight</h2><table id="queries"></table>
 <h2>Recent completions</h2><table id="recent"></table>
 <h2>Principals</h2><table id="principals"></table>
+<h2>Query server</h2><div id="server">not running</div>
+<table id="servertab"></table>
 <script>
 const $=id=>document.getElementById(id);
 async function j(u){const r=await fetch(u);return r.json()}
@@ -294,6 +316,23 @@ async function tick(){
    "</td><td>"+v.device_s.toFixed(4)+"</td><td>"+v.rows_out+
    "</td><td>"+v.h2d_bytes+"</td><td>"+v.compiles+
    "</td></tr>").join("");
+ const sv=await j("/api/server");
+ if(!sv.running){$("server").textContent="not running";
+  $("server").className="ok";$("servertab").innerHTML="";}
+ else{
+  $("server").className=sv.draining?"bad":"ok";
+  $("server").textContent=sv.addr+(sv.draining?" DRAINING":" serving")+
+   " · workers "+sv.workers.busy+"/"+sv.workers.total+
+   " · queue "+sv.queue.queued+"/"+sv.quotas.queue_depth+
+   " · running "+sv.queue.running+
+   (sv.counters.shed?" · shed "+sv.counters.shed:"");
+  $("servertab").innerHTML="<tr><th>tenant</th><th>queued</th>"+
+   "<th>running</th><th>admitted</th><th>shed</th></tr>"+
+   Object.entries(sv.queue.principals).map(([p,v])=>"<tr><td>"+
+    esc(p)+"</td><td>"+v.queued+"</td><td>"+v.running+"</td><td>"+
+    v.admitted+"</td><td"+(v.shed?' class="bad">':">")+v.shed+
+    "</td></tr>").join("");
+ }
 }
 async function cancelQ(id){
  await fetch("/api/queries/"+encodeURIComponent(id)+"/cancel",
@@ -506,6 +545,8 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     self._json(_queries_payload(qs))
                 elif path == "/api/principals":
                     self._json(_principals_payload())
+                elif path == "/api/server":
+                    self._json(_server_payload())
                 elif _CANCEL_RE.match(path):
                     # cancel mutates: POST-only, so a prefetching
                     # browser/crawler can never kill a query

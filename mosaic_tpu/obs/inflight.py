@@ -34,18 +34,19 @@ disables registration entirely (the bench overhead A/B's off arm).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 from .context import current_trace_id
 
 __all__ = ["QueryCancelled", "QueryTicket", "InflightRegistry",
            "inflight", "checkpoint", "charge_device_seconds",
            "charge_h2d_bytes", "charge_d2h_bytes", "note_rows",
-           "note_rows_in", "note_strategies"]
+           "note_rows_in", "note_strategies", "ticket_observer"]
 
 _qids = itertools.count(1)
 
@@ -191,6 +192,13 @@ class InflightRegistry:
         if metrics.enabled:
             metrics.count("inflight/registered")
             metrics.gauge("inflight/active", float(len(self._active)))
+        cb = getattr(_registration_observer, "cb", None)
+        if cb is not None:
+            # observer trouble must never fail the query it watches
+            try:
+                cb(t)
+            except Exception:
+                pass
         return t
 
     def finish(self, ticket: Optional[QueryTicket],
@@ -248,6 +256,31 @@ class InflightRegistry:
 
 #: the process-global registry every SQLSession.sql() call feeds
 inflight = InflightRegistry()
+
+#: thread-local ticket-registration observer (see ticket_observer)
+_registration_observer = threading.local()
+
+
+@contextlib.contextmanager
+def ticket_observer(cb: Callable[[QueryTicket], None]) -> Iterator[None]:
+    """Watch ticket registrations made on THIS thread.
+
+    ``SQLSession.sql()`` opens its own trace and registers its own
+    ticket, so a caller that needs the query id — the serve layer's
+    per-request handler, which must route client disconnects and
+    server deadlines into :meth:`InflightRegistry.cancel` — has no
+    handle on it.  Inside this context every :meth:`~InflightRegistry.
+    register` call on the current thread passes the fresh ticket to
+    ``cb`` before any query work runs.  Thread-local by design:
+    pipeline workers spawned by the query inherit its *trace*, not
+    this hook, so nested streamed stages never re-observe.  Observer
+    exceptions are swallowed (watching a query must not fail it)."""
+    prev = getattr(_registration_observer, "cb", None)
+    _registration_observer.cb = cb
+    try:
+        yield
+    finally:
+        _registration_observer.cb = prev
 
 
 # ------------------------------------------------------------- probes

@@ -66,6 +66,8 @@ EVENTS = frozenset({
     "fusion_group", "fusion_bailout", "fusion_plan_error",
     # memory plane
     "mem_admit_denied", "mem_chunk_shrink", "mem_leak",
+    # query service (serve/): overload shedding + drain lifecycle
+    "serve_shed", "serve_drain",
     # SLO + profiler
     "slo_breach", "slo_recovered", "profiler",
     # pipeline observer hook failures

@@ -47,7 +47,8 @@ from .recorder import recorder
 from .timeseries import TimeSeriesStore, timeseries
 
 __all__ = ["SLObjective", "SLOMonitor", "monitor",
-           "default_objectives", "principal_objectives", "KINDS"]
+           "default_objectives", "principal_objectives",
+           "serve_objectives", "KINDS"]
 
 KINDS = ("latency", "error_rate", "counter_rate", "gauge_max")
 
@@ -169,6 +170,28 @@ def principal_objectives(principal: str,
                     kind="counter_rate",
                     series=f"principal/queries/{principal}",
                     max_rate=max_qps),
+    ]
+
+
+def serve_objectives(queue_depth: int,
+                     request_ms_ceiling: float = 30_000.0
+                     ) -> List[SLObjective]:
+    """The query-server objective pair ``QueryServer.start``
+    registers: a ``gauge_max`` ceiling on end-to-end request latency
+    (the serve/request_ms series the server records per request) and a
+    ``gauge_max`` on admission-queue occupancy at 90% of the
+    configured depth — sustained near-full queue means the server is
+    living off the shed path, which is degrade-not-die working as
+    designed but an operator signal all the same."""
+    return [
+        SLObjective(name="serve_request_latency",
+                    kind="gauge_max",
+                    series="serve/request_ms",
+                    ceiling=request_ms_ceiling),
+        SLObjective(name="serve_queue_saturation",
+                    kind="gauge_max",
+                    series="serve/queue_depth",
+                    ceiling=max(1.0, 0.9 * float(queue_depth))),
     ]
 
 

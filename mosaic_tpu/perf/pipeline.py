@@ -37,7 +37,7 @@ import numpy as np
 from ..obs import metrics
 from ..resilience import faults
 
-__all__ = ["stream", "chunk_rows", "donate_jit"]
+__all__ = ["stream", "chunk_rows", "donate_jit", "staged_put"]
 
 #: fetches allowed in flight before the dispatch loop drains the
 #: oldest — double buffering needs exactly one fetch overlapping the
@@ -79,6 +79,35 @@ def donate_jit(fn, donate_argnums=(0,)):
 def _to_host(out):
     import jax
     return jax.tree_util.tree_map(np.asarray, out)
+
+
+def staged_put(payload, site: str = "pipeline.staged",
+               put: Optional[Callable] = None):
+    """Stage one host batch device-side through the pipeline's
+    accounting choke: ``jax.device_put`` (or ``put``), H2D byte
+    metrics + per-query ticket charge, and a device-memory ledger
+    registration under ``site``.  Returns ``(device_value, token)``;
+    the caller owns the token and must ``memwatch.release(token)``
+    once the staged buffer is consumed (token is None whenever the
+    ledger is off).  This is the single-launch counterpart of
+    :func:`stream`'s internal staging — non-streamed call sites (the
+    serve layer's micro-batch launch) use it so the jit-raw-device-put
+    lint choke and the leak sentinel both see their transfers."""
+    import jax
+    from ..obs.inflight import charge_h2d_bytes, inflight
+    from ..obs.memwatch import device_keys_of, memwatch
+    dev = (put or jax.device_put)(payload)
+    tok = None
+    # the tree walk is skipped entirely when nothing is listening
+    if metrics.enabled or inflight._by_trace or memwatch.enabled:
+        nb = _tree_bytes(dev)
+        if metrics.enabled:         # host->device staging bytes
+            metrics.count("pipeline/h2d_bytes", nb)
+        charge_h2d_bytes(nb)        # per-query attribution
+        if memwatch.enabled:
+            tok = memwatch.register(site, nb,
+                                    devices=device_keys_of(dev))
+    return dev, tok
 
 
 def stream(chunks: Sequence, compute: Callable,
@@ -143,8 +172,7 @@ def stream(chunks: Sequence, compute: Callable,
         return []
     import time as _time
     import jax
-    from ..obs.inflight import (charge_d2h_bytes, charge_h2d_bytes,
-                                checkpoint, inflight)
+    from ..obs.inflight import charge_d2h_bytes, checkpoint, inflight
     from ..obs.memwatch import device_keys_of, mem_budget, memwatch
     if put is None:
         put = jax.device_put
@@ -189,18 +217,7 @@ def stream(chunks: Sequence, compute: Callable,
             else host
 
     def staged(payload):
-        dev = put(payload)
-        tok = None
-        # the tree walk is skipped entirely when nothing is listening
-        if metrics.enabled or inflight._by_trace or memwatch.enabled:
-            nb = _tree_bytes(dev)
-            if metrics.enabled:     # host->device staging, per chunk
-                metrics.count("pipeline/h2d_bytes", nb)
-            charge_h2d_bytes(nb)    # per-query attribution
-            if memwatch.enabled:
-                tok = memwatch.register(f"{site}/staged", nb,
-                                        devices=device_keys_of(dev))
-        return dev, tok
+        return staged_put(payload, site=f"{site}/staged", put=put)
 
     def maybe_split(j):
         # degrade-not-die: while any device sits past the pressure

@@ -1,0 +1,27 @@
+"""Multi-tenant query service: a long-lived server over one
+SQLSession — bounded admission with per-tenant quotas, micro-batched
+point lookups, cooperative cancel on disconnect/deadline, and
+degrade-not-die overload behavior (shed lowest priority first, drain
+on SIGTERM).  Stdlib only: asyncio streams + hand-rolled HTTP/1.1.
+
+Usage::
+
+    from mosaic_tpu.serve import QueryServer
+    with QueryServer(session, port=8817) as srv:
+        srv.install_sigterm_drain()
+        ...
+
+Tuned by the ``mosaic.serve.*`` conf keys (docs/usage/serving.md).
+"""
+
+from .admission import AdmissionQueue, Deny, ServeRequest
+from .batching import KERNEL_NAME, execute_batch
+from .server import QueryServer, current_server, install_sigterm_drain
+from .workers import WorkerPool
+
+__all__ = [
+    "AdmissionQueue", "Deny", "ServeRequest",
+    "KERNEL_NAME", "execute_batch",
+    "QueryServer", "current_server", "install_sigterm_drain",
+    "WorkerPool",
+]

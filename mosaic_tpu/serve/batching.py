@@ -1,0 +1,152 @@
+"""Micro-batching: several point-lookup queries, one device launch.
+
+Small cell-id lookups dominate multi-tenant point workloads, and each
+one alone wastes a device dispatch on a few thousand rows.  Queries
+classified by :func:`~..sql.engine.classify_batchable` share a batch
+signature ``(function, resolution)``; a worker that picks one up
+drains every compatible queued request (``AdmissionQueue.
+take_compatible``, bounded by ``mosaic.serve.batch.max``), concatenates
+the member tables' coordinate columns, pads to the existing pow2
+bucket (so batch-size jitter never recompiles), and runs ONE jitted
+kernel from the shared warm cache.  Per-row math is elementwise
+(``CustomIndexSystem.point_to_cell_jax`` and friends), so each
+member's slice of the batched output is bit-identical to what its
+query would have produced alone — the serial path (``batch.max=1``)
+runs the very same kernel one query at a time, which is what the
+parity + fewer-launches acceptance drill compares via the
+:class:`~..obs.profiler.KernelLedger`.
+
+Accounting stays per-query: every member gets its own
+:class:`~..obs.inflight.QueryTicket` under a synthetic trace id (so
+the shared launch's ledger charge does NOT auto-join any one member),
+and the launch's device seconds / H2D / D2H bytes are split across
+members by row share before each ticket completes through the normal
+:func:`~..obs.accounting.complete` path — audit records, principal
+meter, SLOs and the leak sentinel all see N queries, not one.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+import numpy as np
+
+from ..obs import metrics
+from ..obs.accounting import complete as _complete
+from ..obs.inflight import inflight
+from ..perf.bucketing import pow2_bucket
+from ..perf.jit_cache import kernel_cache
+from ..perf.pipeline import staged_put
+from ..sql.engine import Table
+from .admission import ServeRequest
+
+__all__ = ["execute_batch", "KERNEL_NAME"]
+
+#: kernel-ledger / jit-cache name of the shared point-lookup kernel —
+#: the loadtest and the parity drill count launches under this name
+KERNEL_NAME = "serve/point_lookup"
+
+
+def _member_tickets(members: List[ServeRequest]) -> list:
+    """Open one ticket per member under a synthetic per-member trace
+    id: tickets stay individually addressable (cancel-on-disconnect)
+    while the batch launch itself runs traceless, so the kernel
+    ledger's automatic trace join charges nobody twice — the split
+    below is the only device-seconds feed."""
+    tickets = []
+    for m in members:
+        t = inflight.register(m.label, principal=m.principal,
+                              deadline_ms=m.deadline_ms,
+                              trace_id=f"serve-batch:{m.seq}")
+        if t is not None:
+            t.strategies["serve"] = f"batched[{len(members)}]"
+        m.attach_ticket(t)
+        tickets.append(t)
+    return tickets
+
+
+def execute_batch(session, members: List[ServeRequest]) -> None:
+    """Run one micro-batch (possibly of size 1) and resolve every
+    member's future.  Members must share a batch signature."""
+    lookup = members[0].lookup
+    system = session.mc.index_system
+    res = lookup.res
+    tickets = _member_tickets(members)
+    t0 = time.perf_counter()
+    try:
+        parts = []
+        for m in members:
+            table = session.table(m.lookup.table)
+            parts.append(np.stack(
+                [np.asarray(table.columns[m.lookup.lon], np.float64),
+                 np.asarray(table.columns[m.lookup.lat], np.float64)],
+                axis=-1))
+        rows_list = [len(p) for p in parts]   # authoritative (the
+        # catalog may have grown since classification froze .rows)
+        xy = np.concatenate(parts, axis=0) if len(parts) > 1 \
+            else parts[0]
+        n = len(xy)
+        bucket = pow2_bucket(n)
+        if bucket > n:
+            xy = np.concatenate(
+                [xy, np.zeros((bucket - n, 2), np.float64)], axis=0)
+        key = (getattr(system, "name", type(system).__name__),
+               repr(getattr(system, "conf", None)), res, bucket)
+
+        def _build():
+            import jax
+            return jax.jit(lambda a: system.point_to_cell_jax(a, res))
+
+        kernel = kernel_cache.get_or_build(KERNEL_NAME, key, _build)
+        dev, tok = staged_put(xy, site=f"{KERNEL_NAME}/staged")
+        try:
+            launch_t = time.perf_counter()
+            cells = np.asarray(kernel(dev))[:n]     # blocks until done
+            launch_s = time.perf_counter() - launch_t
+        finally:
+            from ..obs.memwatch import memwatch
+            memwatch.release(tok)
+        from ..obs.profiler import ledger
+        ledger.observe(KERNEL_NAME, key, launch_s, rows=n)
+        if metrics.enabled:
+            metrics.count("serve/batches")
+            metrics.count("serve/batched_queries", float(len(members)))
+    except BaseException as exc:
+        for m, t in zip(members, tickets):
+            _complete(t, outcome="error", error=exc)
+            m.resolve(500, {"error": f"{type(exc).__name__}: {exc}"},
+                      "error")
+        if metrics.enabled:
+            metrics.count("serve/errors")
+        return
+    # split: per-member result slice + per-member cost share
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    bytes_in = xy.nbytes
+    bytes_out = cells.nbytes
+    off = 0
+    for m, t, rows in zip(members, tickets, rows_list):
+        part = cells[off:off + rows]
+        off += rows
+        if t is not None:
+            share = rows / max(1, n)
+            t.device_s += launch_s * share
+            t.h2d_bytes += int(bytes_in * share)
+            t.d2h_bytes += int(bytes_out * share)
+            t.rows_in = rows
+            t.rows = rows
+        if m.cancel_reason is not None or \
+                (t is not None and t.cancel_requested):
+            reason = m.cancel_reason or t._cancel_reason or "cancel"
+            outcome = "deadline" if reason == "deadline" \
+                else "cancelled"
+            _complete(t, outcome=outcome, wall_ms=wall_ms)
+            m.resolve(499 if outcome == "cancelled" else 504,
+                      {"error": outcome}, outcome)
+            continue
+        table = session.table(m.lookup.table)
+        cols = {}
+        for name, src in m.lookup.outputs:
+            cols[name] = part if src is None else table.columns[src]
+        _complete(t, outcome="ok", wall_ms=wall_ms)
+        m.resolve(200, Table(cols), "ok")

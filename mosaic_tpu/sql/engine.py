@@ -25,8 +25,9 @@ reference's users filter tessellations.
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -1000,3 +1001,101 @@ class SQLSession:
             args = [self._eval(a, env) for a in e.args]
             return self.mc.call(e.name, *args)
         raise SQLError(f"cannot evaluate {e!r}")
+
+
+# ---------------------------------------------- batchable point lookups
+
+#: calls the serve-layer micro-batcher may coalesce across queries:
+#: elementwise cell-id assignment over scalar coordinate columns — one
+#: row in, one row out, no cross-row state — so concatenating several
+#: queries' rows into one padded device launch returns bit-identical
+#: per-row results (serve/batching.py executes; this module only
+#: classifies, because the query shape is the engine's contract)
+BATCHABLE_CALLS = {"grid_longlatascellid"}
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchableLookup:
+    """Classification of one micro-batchable point-lookup query.
+
+    ``outputs`` preserves the SELECT-item order the engine would
+    produce: ``(name, column)`` entries echo a source column through
+    unchanged, the single ``(name, None)`` entry is the lookup call's
+    cell-id result — so the batcher can assemble a result table
+    column-for-column identical to :meth:`SQLSession.sql`."""
+
+    table: str                       # catalog name (lowercased)
+    func: str                        # the BATCHABLE_CALLS member
+    res: int                         # the call's literal resolution
+    lon: str                         # x/longitude column name
+    lat: str                         # y/latitude column name
+    outputs: Tuple[Tuple[str, Optional[str]], ...]
+    rows: int                        # table length at classification
+
+    @property
+    def signature(self) -> tuple:
+        """Queries with equal signatures may share one device launch
+        (same kernel, same static args; rows just concatenate)."""
+        return (self.func, self.res)
+
+
+def classify_batchable(query: str, session: "SQLSession",
+                       max_rows: int = 0) -> Optional[BatchableLookup]:
+    """Decide whether ``query`` is a micro-batchable point lookup.
+
+    The shape is deliberately narrow: a single-table ``SELECT`` whose
+    items are plain columns plus exactly one :data:`BATCHABLE_CALLS`
+    call over ``(numeric column, numeric column, integer literal)`` —
+    no join, filter, generator, aggregate, ordering, or limit, and at
+    most ``max_rows`` source rows (0 = unlimited).  Anything else
+    returns None and runs the ordinary ``sql()`` path; classification
+    must never raise on arbitrary input (the serve layer probes every
+    admitted query with it)."""
+    try:
+        q = parse(query)
+    except Exception:
+        return None                  # not even parseable SELECT syntax
+    if q.explain is not None or q.join is not None or \
+            q.where is not None or q.group_by is not None or \
+            q.having is not None or q.order_by or q.limit is not None:
+        return None
+    call: Optional[Call] = None
+    outputs: List[Tuple[str, Optional[str]]] = []
+    for pos, it in enumerate(q.items):
+        e = it.expr
+        if isinstance(e, Call):
+            if call is not None or e.name not in BATCHABLE_CALLS:
+                return None
+            if len(e.args) != 3 or \
+                    not isinstance(e.args[0], Column) or \
+                    not isinstance(e.args[1], Column) or \
+                    not isinstance(e.args[2], Literal) or \
+                    not isinstance(e.args[2].value, int):
+                return None
+            call = e
+            outputs.append((it.alias or e.name, None))
+        elif isinstance(e, Column) and e.table is None:
+            outputs.append((it.alias or e.name, e.name))
+        else:
+            return None              # Star / expression / qualified col
+    if call is None:
+        return None
+    try:
+        table = session.table(q.table.name)
+    except SQLError:
+        return None
+    lon, lat = call.args[0].name, call.args[1].name
+    for name in {lon, lat} | {c for _, c in outputs if c is not None}:
+        if name not in table.columns:
+            return None
+    for name in (lon, lat):
+        col = table.columns[name]
+        if not isinstance(col, np.ndarray) or \
+                not np.issubdtype(col.dtype, np.number):
+            return None
+    if max_rows and len(table) > max_rows:
+        return None
+    return BatchableLookup(table=q.table.name.lower(), func=call.name,
+                           res=int(call.args[2].value), lon=lon,
+                           lat=lat, outputs=tuple(outputs),
+                           rows=len(table))

@@ -442,3 +442,48 @@ def test_gpkg_row_corruption_skip_drops_only_that_row(fault_plan,
     got2, cols2 = read_gpkg(path)
     assert write_wkt(got2) == write_wkt(geoms)
     assert cols2["name"] == ["a", "b", "c"]
+
+
+# --------------------------------------- whole-file open fault sites
+
+def test_shapefile_open_fault_raises_then_clean_read_matches(
+        fault_plan):
+    """``shapefile.read`` guards the whole-file open: an injected
+    failure there surfaces straight to the caller (nothing salvageable
+    before the .shp buffer exists), and the next, un-armed read is
+    byte-for-byte what an undamaged session sees."""
+    from mosaic_tpu.core.geometry.wkt import write_wkt
+    from mosaic_tpu.io.shapefile import read_shapefile
+
+    plan = fault_plan("seed=61;site=shapefile.read,fails=1,error=OSError")
+    with pytest.raises(OSError):
+        read_shapefile(SHP_FIX)
+    assert ("shapefile.read", 0, "OSError") in plan.injected
+
+    faults.disarm()
+    geoms, cols = read_shapefile(SHP_FIX)
+    geoms2, cols2 = read_shapefile(SHP_FIX)
+    assert write_wkt(geoms) == write_wkt(geoms2)
+    assert cols == cols2
+
+
+def test_netcdf_open_fault_raises_then_clean_read_matches(fault_plan):
+    """Same contract for ``netcdf.read``: the pre-header fault site
+    fails the whole decode (header damage is never salvageable), and
+    recovery after disarm is exact."""
+    from mosaic_tpu.io.netcdf import read_netcdf, write_netcdf
+
+    h, w = 6, 9
+    yy, xx = np.mgrid[0:h, 0:w]
+    blob = write_netcdf({"sst": (xx + yy).astype(np.float64)},
+                        xs=0.5 + np.arange(w), ys=0.5 + np.arange(h))
+
+    plan = fault_plan("seed=62;site=netcdf.read,fails=1,error=OSError")
+    with pytest.raises(OSError):
+        read_netcdf(blob)
+    assert ("netcdf.read", 0, "OSError") in plan.injected
+
+    faults.disarm()
+    subs = read_netcdf(blob)
+    np.testing.assert_array_equal(np.asarray(subs["sst"].data)[0],
+                                  (xx + yy).astype(np.float64)[::-1])

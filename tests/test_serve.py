@@ -1,0 +1,556 @@
+"""The multi-tenant query service (``serve/``).
+
+Acceptance drills for the serving PR: admission quotas deny with
+Retry-After instead of melting down, overload sheds the lowest
+priority first, two tenants stay isolated (one flooding tenant cannot
+blow the other's latency), a client disconnect or server deadline
+cancels the running query cooperatively (bounded wall, zero leaked
+tickets / threads / device bytes), micro-batched point lookups are
+bit-identical to serial execution while issuing fewer device launches
+(asserted via the kernel ledger), SIGTERM drains instead of dropping,
+and the ``serve.accept`` / ``serve.dispatch`` fault sites degrade one
+request without taking the server down.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.obs import metrics
+from mosaic_tpu.obs.accounting import audit, meter
+from mosaic_tpu.obs.inflight import inflight
+from mosaic_tpu.obs.memwatch import memwatch
+from mosaic_tpu.obs.profiler import ledger
+from mosaic_tpu.obs.recorder import recorder
+from mosaic_tpu.resilience import faults
+from mosaic_tpu.serve import (AdmissionQueue, QueryServer, ServeRequest,
+                              KERNEL_NAME)
+from mosaic_tpu.sql import SQLSession
+
+
+@pytest.fixture(scope="module")
+def mc():
+    return MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)")
+
+
+@pytest.fixture(scope="module")
+def session(mc):
+    s = SQLSession(mc)
+    rng = np.random.default_rng(7)
+    n = 50_000
+    s.create_table("pts", {
+        "lon": rng.uniform(-170.0, 170.0, n),
+        "lat": rng.uniform(-80.0, 80.0, n),
+        "v": rng.uniform(0.0, 1.0, n)})
+    s.create_table("small", {
+        "lon": rng.uniform(-170.0, 170.0, 256),
+        "lat": rng.uniform(-80.0, 80.0, 256),
+        "id": np.arange(256)})
+    return s
+
+
+@pytest.fixture
+def serve_env():
+    """Clean obs singletons + config around each server test."""
+    prev = _config.default_config()
+    audit.reset()
+    meter.reset()
+    metrics.reset()
+    metrics.enable()
+    recorder.reset()
+    recorder.enable()
+    memwatch.reset()
+    yield
+    faults.disarm()
+    _config.set_default_config(prev)
+    audit.reset()
+    meter.reset()
+    metrics.disable()
+    metrics.reset()
+    recorder.reset()
+    memwatch.reset()
+
+
+def _conf(**keys):
+    """Apply ``mosaic.serve.*`` (or any) conf keys to the process
+    default config; serve_env restores the previous config."""
+    cfg = _config.default_config()
+    for k, v in keys.items():
+        cfg = _config.apply_conf(cfg, k.replace("_", "."), str(v))
+    _config.set_default_config(cfg)
+
+
+def _post(port, sql, principal="t", priority=None, deadline_ms=None,
+          timeout=30.0):
+    """POST /query; returns (status, headers dict, body bytes)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        headers = {"X-Mosaic-Principal": principal}
+        if priority is not None:
+            headers["X-Mosaic-Priority"] = str(priority)
+        if deadline_ms is not None:
+            headers["X-Mosaic-Deadline-Ms"] = str(deadline_ms)
+        conn.request("POST", "/query", body=sql.encode(),
+                     headers=headers)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _rows(body: bytes):
+    """Decode a 200 JSON-lines response -> (columns, row list)."""
+    lines = body.decode().splitlines()
+    head = json.loads(lines[0])
+    rows = []
+    for ln in lines[1:]:
+        rows.extend(json.loads(ln))
+    assert len(rows) == head["rows"]
+    return head["columns"], rows
+
+
+_POINT_SQL = ("SELECT id, grid_longlatascellid(lon, lat, 5) AS cell "
+              "FROM small")
+_SLOW_SQL = ("SELECT count(*) AS n, max(v) AS mx FROM pts "
+             "WHERE v > 0.25")
+
+
+# ------------------------------------------------------------- basics
+
+def test_http_basics_and_bad_requests(session, serve_env):
+    with QueryServer(session, workers=2) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert json.loads(r.read())["status"] == "ok"
+        conn.close()
+
+        status, _, body = _post(srv.port,
+                                "SELECT id FROM small LIMIT 3")
+        assert status == 200
+        cols, rows = _rows(body)
+        assert cols == ["id"] and rows == [[0], [1], [2]]
+
+        status, _, body = _post(srv.port, "SELECT FROM nothing ((")
+        assert status == 400
+        status, _, body = _post(srv.port, "SELECT x FROM no_table")
+        assert status == 400
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+
+        # JSON body form
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("POST", "/query",
+                     body=json.dumps(
+                         {"sql": "SELECT id FROM small LIMIT 1"}),
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        conn.close()
+
+
+def test_stats_and_dashboard_payload(session, serve_env):
+    from mosaic_tpu.obs.dashboard import _server_payload
+    assert _server_payload() == {"running": False}
+    with QueryServer(session, workers=1) as srv:
+        _post(srv.port, "SELECT id FROM small LIMIT 1", principal="a")
+        st = srv.stats()
+        assert st["running"] and st["workers"]["total"] == 1
+        assert st["queue"]["principals"]["a"]["admitted"] == 1
+        assert _server_payload()["addr"].endswith(str(srv.port))
+    assert _server_payload() == {"running": False}
+
+
+# ----------------------------------------------------------- admission
+
+def test_rate_quota_denies_with_retry_after(session, serve_env):
+    _conf(mosaic_serve_quota_qps=2)
+    with QueryServer(session, workers=2) as srv:
+        outcomes = []
+        for _ in range(6):
+            status, headers, body = _post(
+                srv.port, "SELECT id FROM small LIMIT 1")
+            outcomes.append(status)
+            if status == 429:
+                assert "Retry-After" in headers
+                assert json.loads(body)["reason"] == "rate_quota"
+        assert outcomes.count(200) >= 2       # the quota's worth ran
+        assert 429 in outcomes                # the rest were refused
+        assert metrics.counter_value("serve/denied_rate_quota") >= 1
+
+
+def test_concurrency_quota_denies(session, serve_env, fault_plan):
+    _conf(mosaic_serve_quota_concurrency=1)
+    fault_plan("seed=3;site=sql.query,mode=delay,fails=1,delay_ms=400")
+    with QueryServer(session, workers=2) as srv:
+        results = {}
+
+        def slow():
+            results["slow"] = _post(srv.port, _SLOW_SQL,
+                                    principal="heavy")[0]
+
+        t = threading.Thread(target=slow, daemon=True)
+        t.start()
+        time.sleep(0.15)       # the slow query is inside its stall
+        deadline = time.perf_counter() + 2.0
+        denied = None
+        while time.perf_counter() < deadline:
+            status, headers, body = _post(
+                srv.port, "SELECT id FROM small LIMIT 1",
+                principal="heavy")
+            if status == 429:
+                denied = json.loads(body)
+                assert "Retry-After" in headers
+                break
+            time.sleep(0.02)
+        t.join(10)
+        assert denied is not None and \
+            denied["reason"] == "concurrency_quota"
+        assert results["slow"] == 200         # the running query won
+
+
+def test_queue_full_sheds_lowest_priority_first(serve_env):
+    q = AdmissionQueue(depth=2, quota_concurrency=0, quota_qps=0.0)
+    low1 = ServeRequest("SELECT 1", "bulk", priority=-1)
+    low2 = ServeRequest("SELECT 2", "bulk", priority=-1)
+    assert q.offer(low1) is None and q.offer(low2) is None
+    # arriving high priority evicts the newest lowest-priority entry
+    # (the oldest has waited longest and is next in line to run)
+    high = ServeRequest("SELECT 3", "interactive", priority=5)
+    assert q.offer(high) is None
+    assert low2.future.done() and not low1.future.done()
+    status, body, outcome = low2.future.result()
+    assert status == 429 and outcome == "shed"
+    # arriving low priority against a full same-priority queue is
+    # itself the victim
+    low3 = ServeRequest("SELECT 4", "bulk", priority=-1)
+    deny = q.offer(low3)
+    assert deny is not None and deny.reason == "shed"
+    sheds = recorder.events("serve_shed")
+    assert len(sheds) == 2
+    assert {e["principal"] for e in sheds} == {"bulk"}
+    assert metrics.counter_value("serve/shed") == 2
+    snap = q.snapshot()
+    assert snap["queued"] == 2
+    assert snap["principals"]["bulk"]["shed"] == 2
+
+
+def test_draining_queue_answers_503(serve_env):
+    q = AdmissionQueue(depth=4, quota_concurrency=0, quota_qps=0.0)
+    q.start_drain()
+    deny = q.offer(ServeRequest("SELECT 1", "t"))
+    assert deny is not None and deny.status == 503
+    assert deny.reason == "draining"
+
+
+# ----------------------------------------------------- tenant isolation
+
+def test_two_tenant_isolation_under_flood(session, serve_env):
+    """Tenant ``flood`` saturates its concurrency quota; tenant
+    ``calm`` keeps getting prompt answers — per-tenant quotas mean one
+    tenant's burst degrades that tenant, not the service."""
+    _conf(mosaic_serve_quota_concurrency=2,
+          mosaic_serve_workers=4, mosaic_serve_queue_depth=4)
+    with QueryServer(session) as srv:
+        stop = threading.Event()
+        flood_status = []
+
+        def flooder():
+            while not stop.is_set():
+                try:
+                    flood_status.append(
+                        _post(srv.port, _SLOW_SQL,
+                              principal="flood")[0])
+                except Exception:
+                    flood_status.append(-1)
+
+        threads = [threading.Thread(target=flooder, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)                   # let the flood build
+        calm_ms = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            status, _, _ = _post(srv.port,
+                                 "SELECT id FROM small LIMIT 5",
+                                 principal="calm")
+            calm_ms.append((time.perf_counter() - t0) * 1e3)
+            assert status == 200          # never denied: own quota
+        stop.set()
+        for t in threads:
+            t.join(10)
+        # the flooding tenant got throttled, the calm one never did
+        assert flood_status.count(429) > 0
+        assert metrics.counter_value("serve/denied") > 0
+        calm_p99 = float(np.percentile(calm_ms, 99))
+        assert calm_p99 < 5_000.0, \
+            f"calm tenant p99 {calm_p99:.0f} ms under flood"
+        snap = srv.queue.snapshot()["principals"]
+        assert "calm" not in {p for p, v in snap.items()
+                              if v["shed"] > 0}
+
+
+# ------------------------------------------- cancellation + deadlines
+
+def test_disconnect_cancels_running_query(session, serve_env,
+                                          fault_plan):
+    """Client drops mid-query -> the EOF watch cancels the ticket ->
+    the stalled query raises at its next checkpoint (bounded wall) and
+    books as ``cancelled`` with zero leaked tickets or device bytes."""
+    fault_plan("seed=5;site=sql.query,mode=delay,fails=1,delay_ms=600")
+    with QueryServer(session, workers=2) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("POST", "/query", body=_SLOW_SQL.encode(),
+                     headers={"X-Mosaic-Principal": "dropper"})
+        time.sleep(0.15)                  # query is inside the stall
+        conn.close()                      # hang up without reading
+        deadline = time.perf_counter() + 5.0
+        rec = None
+        while time.perf_counter() < deadline:
+            recs = [r for r in audit.records()
+                    if r["principal"] == "dropper"]
+            if recs:
+                rec = recs[-1]
+                break
+            time.sleep(0.02)
+        assert rec is not None, "query never completed after hangup"
+        assert rec["outcome"] == "cancelled"
+        # stalled 600 ms, cancelled at the checkpoint right after —
+        # nowhere near a full execution + response cycle
+        assert rec["cost"]["wall_ms"] < 3_000.0
+        assert len(inflight) == 0         # ticket closed
+        assert memwatch.total_live() == 0 # no live device bytes
+        assert memwatch.leak_count() == 0
+        assert metrics.counter_value("serve/disconnects") == 1
+
+
+def test_deadline_yields_504(session, serve_env, fault_plan):
+    fault_plan("seed=6;site=sql.query,mode=delay,fails=1,delay_ms=500")
+    with QueryServer(session, workers=2) as srv:
+        status, _, body = _post(srv.port, _SLOW_SQL,
+                                principal="sla", deadline_ms=100)
+        assert status == 504
+        assert json.loads(body)["error"] == "deadline"
+        rec = [r for r in audit.records()
+               if r["principal"] == "sla"][-1]
+        assert rec["outcome"] == "deadline"
+        assert len(inflight) == 0
+
+
+# --------------------------------------------------- micro-batching
+
+def test_microbatch_parity_and_fewer_launches(session, serve_env):
+    """K concurrent compatible point lookups: one worker drains them
+    into fewer device launches than queries (kernel ledger), and every
+    tenant's rows are bit-identical to running its query alone."""
+    _conf(mosaic_serve_workers=1, mosaic_serve_batch_window_ms=60,
+          mosaic_serve_batch_max=32)
+    direct = {}
+    for name in ("small",):
+        out = session.sql(_POINT_SQL)
+        direct["small"] = {
+            "id": np.asarray(out.columns["id"]),
+            "cell": np.asarray(out.columns["cell"])}
+    ledger.reset()
+    k = 6
+    with QueryServer(session) as srv:
+        results = [None] * k
+        barrier = threading.Barrier(k)
+
+        def client(i):
+            barrier.wait()
+            results[i] = _post(srv.port, _POINT_SQL,
+                               principal=f"tenant{i}")
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(k)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+    launches = sum(e["launches"] for e in ledger.report()["kernels"]
+                   if e["name"] == KERNEL_NAME)
+    assert 0 < launches < k, \
+        f"{launches} launches for {k} batchable queries"
+    assert metrics.counter_value("serve/batched_queries") == k
+    for i, res in enumerate(results):
+        status, _, body = res
+        assert status == 200, f"tenant{i}: {res}"
+        cols, rows = _rows(body)
+        assert cols == ["id", "cell"]
+        got = np.asarray(rows, dtype=np.int64)
+        assert np.array_equal(got[:, 0], direct["small"]["id"])
+        # bit parity with the serial engine path
+        assert np.array_equal(got[:, 1], direct["small"]["cell"])
+    # per-member accounting: every tenant metered individually
+    rep = meter.report()
+    for i in range(k):
+        assert rep[f"tenant{i}"]["queries"] == 1
+    assert len(inflight) == 0
+    assert memwatch.leak_count() == 0
+
+
+def test_batch_max_one_runs_serially_same_kernel(session, serve_env):
+    """The serial control arm: batch.max=1 runs one launch per query
+    through the same kernel, so the batched arm's fewer-launches claim
+    is measured against a real baseline, not a guess."""
+    _conf(mosaic_serve_workers=1, mosaic_serve_batch_max=1)
+    ledger.reset()
+    k = 3
+    with QueryServer(session) as srv:
+        for i in range(k):
+            status, _, _ = _post(srv.port, _POINT_SQL,
+                                 principal=f"s{i}")
+            assert status == 200
+    launches = sum(e["launches"] for e in ledger.report()["kernels"]
+                   if e["name"] == KERNEL_NAME)
+    assert launches == k
+
+
+# ----------------------------------------------------------- draining
+
+def test_drain_on_sigterm(session, serve_env, fault_plan):
+    """SIGTERM -> drain: the in-flight query finishes (200), new
+    admissions answer 503/refused, the drain event is flight-recorded,
+    and workers exit clean."""
+    fault_plan("seed=8;site=sql.query,mode=delay,fails=1,delay_ms=300")
+    srv = QueryServer(session, workers=2).start()
+    srv.install_sigterm_drain()
+    try:
+        inflight_result = {}
+
+        def slow():
+            inflight_result["status"] = _post(
+                srv.port, _SLOW_SQL, principal="finisher")[0]
+
+        t = threading.Thread(target=slow, daemon=True)
+        t.start()
+        time.sleep(0.1)                   # in flight, inside the stall
+        os.kill(os.getpid(), signal.SIGTERM)
+        t.join(15)
+        assert inflight_result["status"] == 200
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline and \
+                srv._thread is not None:
+            time.sleep(0.05)
+        # post-drain: the listener is gone (connection refused) or
+        # still closing (503 draining) — either way nothing runs
+        try:
+            status, _, _ = _post(srv.port, _SLOW_SQL, timeout=2.0)
+            assert status == 503
+        except OSError:
+            pass
+        assert recorder.events("serve_drain")
+        assert srv.pool.idle()
+        assert len(inflight) == 0
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------------------- chaos
+
+def test_serve_accept_fault_degrades_one_connection(session, serve_env,
+                                                    fault_plan):
+    """An injected ``serve.accept`` fault refuses exactly that
+    connection with a retryable 503; the listener keeps serving."""
+    plan = fault_plan("seed=9;site=serve.accept,fails=1,error=OSError")
+    with QueryServer(session, workers=1) as srv:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 503
+        assert "Retry-After" in dict(r.getheaders())
+        conn.close()
+        assert ("serve.accept", 0, "OSError") in plan.injected
+        status, _, _ = _post(srv.port, "SELECT id FROM small LIMIT 1")
+        assert status == 200
+        assert metrics.counter_value("serve/accept_errors") == 1
+
+
+def test_serve_dispatch_fault_leaks_nothing(session, serve_env,
+                                            fault_plan):
+    """A worker blowing up at ``serve.dispatch`` answers 500 and
+    leaves no leaked ticket, no live device bytes, and a worker pool
+    that still serves the next query."""
+    threads_before = threading.active_count()
+    plan = fault_plan(
+        "seed=10;site=serve.dispatch,fails=1,error=OSError")
+    with QueryServer(session, workers=2) as srv:
+        status, _, body = _post(srv.port,
+                                "SELECT id FROM small LIMIT 1")
+        assert status == 500
+        assert ("serve.dispatch", 0, "OSError") in plan.injected
+        assert metrics.counter_value("serve/dispatch_errors") == 1
+        assert len(inflight) == 0         # no ticket was opened
+        assert memwatch.total_live() == 0
+        assert memwatch.leak_count() == 0
+        status, _, _ = _post(srv.port, "SELECT id FROM small LIMIT 1")
+        assert status == 200              # the pool survived
+    deadline = time.perf_counter() + 5.0
+    while time.perf_counter() < deadline and \
+            threading.active_count() > threads_before:
+        time.sleep(0.05)
+    assert threading.active_count() <= threads_before
+
+
+def test_torn_connection_mid_response_keeps_serving(session,
+                                                    serve_env):
+    """A client that RSTs the socket mid-stream kills only its own
+    response: the server counts it and the next request is clean."""
+    with QueryServer(session, workers=2) as srv:
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10)
+        sql = ("SELECT lon, lat, v FROM pts").encode()
+        sock.sendall(b"POST /query HTTP/1.1\r\n"
+                     b"Host: x\r\nX-Mosaic-Principal: torn\r\n"
+                     b"Content-Length: %d\r\n\r\n%s" %
+                     (len(sql), sql))
+        sock.recv(64)                     # read a little, then RST
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        sock.close()
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            if [r for r in audit.records()
+                    if r["principal"] == "torn"]:
+                break
+            time.sleep(0.02)
+        status, _, _ = _post(srv.port, "SELECT id FROM small LIMIT 1")
+        assert status == 200
+        assert len(inflight) == 0
+        assert memwatch.leak_count() == 0
+
+
+# ------------------------------------------------- config validation
+
+def test_serve_conf_keys_validate(serve_env):
+    cfg = _config.default_config()
+    cfg = _config.apply_conf(cfg, "mosaic.serve.port", "8817")
+    assert cfg.serve_port == 8817
+    with pytest.raises(ValueError):
+        _config.apply_conf(cfg, "mosaic.serve.port", "70000")
+    cfg = _config.apply_conf(cfg, "mosaic.serve.batch.max", "0")
+    assert cfg.serve_batch_max == 0
+    with pytest.raises(ValueError):
+        _config.apply_conf(cfg, "mosaic.serve.batch.max", "-1")
+    cfg = _config.apply_conf(cfg, "mosaic.serve.quota.qps", "2.5")
+    assert cfg.serve_quota_qps == 2.5

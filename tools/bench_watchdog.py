@@ -45,6 +45,7 @@ __all__ = ["LOWER_BETTER", "HIGHER_BETTER", "TREND_ONLY",
 # sync by tests/test_timeseries.py::test_watchdog_metric_lists).
 LOWER_BETTER = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
                 "planner_flagship_ms", "fused_flagship_ms",
+                "serving_p95_ms",
                 "sharded_end_to_end_ms",
                 "tessellate_zones_s",
                 "tessellate_counties_s", "overlay_s",

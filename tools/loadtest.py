@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Load generator for the mosaic_tpu query server (serve/).
+
+Importable (bench.py's ``serving`` record block and the CI smoke lane
+call :func:`run_loadtest` / :func:`deadline_curve` in-process) and a
+CLI::
+
+    python tools/loadtest.py --url http://127.0.0.1:8817 \
+        --clients 8 --duration 3 --sql "SELECT count(*) FROM pts"
+
+N concurrent closed-loop clients (one thread + one keep-alive-free
+HTTP connection each) replay a weighted query mix against ``POST
+/query``; client-observed latency lands in the repo's own metrics
+histograms (``serve/client_ms`` — the same reservoir machinery every
+other percentile in the codebase uses), so the report's p50/p95/p99
+are computed by ``obs.metrics``, not by this script.  Outcomes are
+bucketed by HTTP status: ok (200), denied (429 admission), shed
+(429 with reason=shed), deadline (504), cancelled (499), error.
+
+:func:`deadline_curve` sweeps offered QPS (open-loop pacing) under a
+fixed per-request deadline and reports the deadline-miss fraction at
+each level — the knee of that curve is the server's sustainable
+throughput for an SLO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_HIST = "serve/client_ms"
+
+
+def _post_query(host: str, port: int, sql: str, principal: str,
+                priority: int = 0, deadline_ms: float = 0.0,
+                timeout: float = 30.0) -> Tuple[int, str]:
+    """One POST /query on a fresh connection; returns (status,
+    reason) where reason is the deny reason for 429s, "" otherwise."""
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        headers = {"X-Mosaic-Principal": principal,
+                   "Content-Type": "text/plain"}
+        if priority:
+            headers["X-Mosaic-Priority"] = str(priority)
+        if deadline_ms > 0:
+            headers["X-Mosaic-Deadline-Ms"] = str(deadline_ms)
+        conn.request("POST", "/query", body=sql.encode(),
+                     headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        reason = ""
+        if resp.status in (429, 503):
+            try:
+                reason = json.loads(body).get("reason", "")
+            except Exception:
+                pass
+        return resp.status, reason
+    finally:
+        conn.close()
+
+
+def _bucket(status: int, reason: str) -> str:
+    if status == 200:
+        return "ok"
+    if status == 429:
+        return "shed" if reason == "shed" else "denied"
+    if status == 504:
+        return "deadline"
+    if status == 499:
+        return "cancelled"
+    if status == 503:
+        return "denied"
+    return "error"
+
+
+def run_loadtest(host: str, port: int,
+                 mix: Sequence[Tuple[str, float]],
+                 clients: int = 8,
+                 duration_s: float = 3.0,
+                 principals: Optional[Sequence[str]] = None,
+                 deadline_ms: float = 0.0,
+                 priority_of: Optional[Dict[str, int]] = None
+                 ) -> Dict[str, object]:
+    """Closed-loop burst: ``clients`` threads each loop pick-query →
+    POST → record for ``duration_s``.  ``mix`` is ``[(sql, weight)]``;
+    clients are assigned principals round-robin from ``principals``
+    (default: one shared "loadtest" tenant).  Returns the aggregate
+    report (see module docstring)."""
+    from mosaic_tpu.obs import metrics
+    metrics.enable()
+    principals = list(principals or ["loadtest"])
+    priority_of = priority_of or {}
+    weights = [max(0.0, w) for _, w in mix]
+    total_w = sum(weights) or 1.0
+    cum, acc = [], 0.0
+    for w in weights:
+        acc += w / total_w
+        cum.append(acc)
+    lock = threading.Lock()
+    outcomes: Dict[str, int] = {}
+    by_principal: Dict[str, Dict[str, int]] = {}
+    lat_key = f"{_HIST}@{time.monotonic_ns()}"  # fresh reservoir per run
+
+    def pick(r: float) -> str:
+        for (sql, _), edge in zip(mix, cum):
+            if r <= edge:
+                return sql
+        return mix[-1][0]
+
+    def client(idx: int) -> None:
+        import random
+        rng = random.Random(1_000 + idx)
+        principal = principals[idx % len(principals)]
+        prio = priority_of.get(principal, 0)
+        t_end = time.perf_counter() + duration_s
+        while time.perf_counter() < t_end:
+            sql = pick(rng.random())
+            t0 = time.perf_counter()
+            try:
+                status, reason = _post_query(
+                    host, port, sql, principal, priority=prio,
+                    deadline_ms=deadline_ms)
+            except Exception:
+                status, reason = -1, ""
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            b = _bucket(status, reason)
+            if b == "ok":
+                metrics.observe(lat_key, dt_ms)
+            with lock:
+                outcomes[b] = outcomes.get(b, 0) + 1
+                per = by_principal.setdefault(principal, {})
+                per[b] = per.get(b, 0) + 1
+            if b in ("denied", "shed"):
+                time.sleep(0.01)     # honor the 429 a little
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 30.0)
+    wall = time.perf_counter() - t0
+    snap = metrics.report().get("histograms", {}).get(lat_key, {})
+    n = sum(outcomes.values())
+    return {
+        "clients": clients,
+        "duration_s": round(wall, 3),
+        "requests": n,
+        "qps": round(n / max(1e-9, wall), 1),
+        "ok_qps": round(outcomes.get("ok", 0) / max(1e-9, wall), 1),
+        "outcomes": dict(sorted(outcomes.items())),
+        "by_principal": {p: dict(sorted(v.items()))
+                         for p, v in sorted(by_principal.items())},
+        "latency_ms": {k: snap.get(k) for k in
+                       ("count", "mean", "p50", "p95", "p99", "max")},
+    }
+
+
+def deadline_curve(host: str, port: int, sql: str,
+                   deadline_ms: float,
+                   qps_levels: Sequence[float] = (2, 5, 10, 20, 40),
+                   duration_s: float = 2.0,
+                   principal: str = "loadtest"
+                   ) -> List[Dict[str, object]]:
+    """QPS-vs-deadline-miss curve: open-loop paced offers at each
+    level; a miss is any request that did not come back 200 within
+    the deadline (504s, denies, sheds all count — the client asked
+    and the answer wasn't the data in time)."""
+    curve: List[Dict[str, object]] = []
+    for qps in qps_levels:
+        period = 1.0 / float(qps)
+        results: List[str] = []
+        lock = threading.Lock()
+        threads: List[threading.Thread] = []
+
+        def fire() -> None:
+            try:
+                status, reason = _post_query(
+                    host, port, sql, principal,
+                    deadline_ms=deadline_ms,
+                    timeout=deadline_ms / 1e3 + 5.0)
+            except Exception:
+                status, reason = -1, ""
+            with lock:
+                results.append(_bucket(status, reason))
+
+        t_end = time.perf_counter() + duration_s
+        nxt = time.perf_counter()
+        while time.perf_counter() < t_end:
+            th = threading.Thread(target=fire, daemon=True)
+            th.start()
+            threads.append(th)
+            nxt += period
+            lag = nxt - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+        for th in threads:
+            th.join(deadline_ms / 1e3 + 10.0)
+        n = len(results)
+        miss = sum(1 for b in results if b != "ok")
+        curve.append({"offered_qps": float(qps),
+                      "requests": n,
+                      "miss": miss,
+                      "miss_frac": round(miss / max(1, n), 4)})
+    return curve
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="server base url, e.g. http://127.0.0.1:8817")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--sql", action="append", required=True,
+                    help="query to replay (repeat for a mix; "
+                         "'WEIGHT:SQL' to weight)")
+    ap.add_argument("--principal", action="append", default=None,
+                    help="tenant name (repeat; clients round-robin)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--curve", action="store_true",
+                    help="also sweep the QPS-vs-deadline-miss curve "
+                         "(first --sql, needs --deadline-ms)")
+    args = ap.parse_args(argv)
+    from urllib.parse import urlparse
+    u = urlparse(args.url)
+    host, port = u.hostname or "127.0.0.1", u.port or 80
+    mix: List[Tuple[str, float]] = []
+    for s in args.sql:
+        if ":" in s and s.split(":", 1)[0].replace(".", "").isdigit():
+            w, q = s.split(":", 1)
+            mix.append((q, float(w)))
+        else:
+            mix.append((s, 1.0))
+    report = run_loadtest(host, port, mix, clients=args.clients,
+                          duration_s=args.duration,
+                          principals=args.principal,
+                          deadline_ms=args.deadline_ms)
+    if args.curve and args.deadline_ms > 0:
+        report["deadline_curve"] = deadline_curve(
+            host, port, mix[0][0], args.deadline_ms)
+    json.dump(report, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
