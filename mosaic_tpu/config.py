@@ -137,6 +137,19 @@ MOSAIC_SERVE_DRAIN_MS = "mosaic.serve.drain.ms"
 MOSAIC_SERVE_BATCH_WINDOW_MS = "mosaic.serve.batch.window.ms"
 MOSAIC_SERVE_BATCH_MAX = "mosaic.serve.batch.max"
 MOSAIC_SERVE_BATCH_ROWS_MAX = "mosaic.serve.batch.rows.max"
+# Fleet telemetry plane (obs/spool.py + obs/fleet.py): the directory
+# per-process telemetry spools are written to ("" disables spooling;
+# writes ride the Sampler tick, so mosaic.obs.sample.ms must also be
+# set for periodic snapshots), the spool-mtime age past which the
+# aggregator flags a worker stale (its gauges drop out of the merged
+# view; its counters/histograms stay — completed work doesn't
+# un-happen), the raw-sample window each spool carries per series,
+# and how many recent flight-recorder events ride in each snapshot
+# (the fleet bundle and cross-process trace stitching read these).
+MOSAIC_OBS_FLEET_DIR = "mosaic.obs.fleet.dir"
+MOSAIC_OBS_FLEET_STALE_MS = "mosaic.obs.fleet.stale.ms"
+MOSAIC_OBS_FLEET_WINDOW_MS = "mosaic.obs.fleet.window.ms"
+MOSAIC_OBS_FLEET_EVENTS = "mosaic.obs.fleet.events"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -256,6 +269,12 @@ class MosaicConfig:
     serve_batch_window_ms: float = 2.0
     serve_batch_max: int = 32
     serve_batch_rows_max: int = 4_096
+    # Fleet telemetry plane — see the mosaic.obs.fleet.* key comments
+    # above.  "" = no spooling.
+    obs_fleet_dir: str = ""
+    obs_fleet_stale_ms: float = 5_000.0
+    obs_fleet_window_ms: float = 300_000.0
+    obs_fleet_events: int = 512
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -443,6 +462,10 @@ _CONF_FIELDS = {
     MOSAIC_SERVE_BATCH_MAX: ("serve_batch_max", _as_count),
     MOSAIC_SERVE_BATCH_ROWS_MAX: ("serve_batch_rows_max",
                                   _as_blocksize),
+    MOSAIC_OBS_FLEET_DIR: ("obs_fleet_dir", _as_str),
+    MOSAIC_OBS_FLEET_STALE_MS: ("obs_fleet_stale_ms", _as_millis),
+    MOSAIC_OBS_FLEET_WINDOW_MS: ("obs_fleet_window_ms", _as_millis),
+    MOSAIC_OBS_FLEET_EVENTS: ("obs_fleet_events", _as_count),
 }
 
 

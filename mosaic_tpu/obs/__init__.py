@@ -49,6 +49,14 @@ shim).  Twelve parts:
   + auto-registered per-principal SLOs), the bounded query audit log
   (ring + ``mosaic.audit.path`` JSONL spool), and the
   ``accounted()`` context manager for non-SQL workloads.
+* ``obs.spool`` / ``obs.fleet`` — the fleet telemetry plane: each
+  process spools an atomic versioned snapshot (registry buckets,
+  series tails, SLO state, recent events) to ``mosaic.obs.fleet.dir``
+  on the sampler tick; :class:`FleetAggregator` merges N spools into
+  one exact fleet view (counter sums, worker-labeled gauge max,
+  bucket-wise histogram merges) with stale-worker degrade, fleet SLO
+  evaluation and cross-process trace stitching via W3C
+  ``traceparent`` links (``context.link_traceparent``).
 * ``obs.memwatch`` — the device-memory plane: the live-buffer
   :class:`DeviceMemoryLedger` (per-(site, trace, device) bytes,
   ``mem/live_bytes`` / ``mem/pressure`` gauges, per-query peak
@@ -73,10 +81,13 @@ from .accounting import (AuditLog, PrincipalMeter, accounted, audit,
                          complete, meter)
 from .chrometrace import chrome_trace_events, export_chrome_trace
 from .context import (TraceContext, current_trace, current_trace_id,
-                      install_thread_propagation, new_trace, root_trace,
-                      traced)
+                      install_thread_propagation, link_traceparent,
+                      make_traceparent, new_trace, parse_traceparent,
+                      root_trace, traced)
 from .dashboard import serve_dashboard
 from .devicemon import DeviceMonitor, devicemon, mesh_device_keys
+from .fleet import (FleetAggregator, FleetStore, WorkerState,
+                    aggregator_for)
 from .inflight import (InflightRegistry, QueryCancelled, QueryTicket,
                        checkpoint, inflight)
 from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
@@ -85,13 +96,16 @@ from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
 from .memwatch import (DeviceMemoryLedger, MemoryBudget, device_keys_of,
                        mem_budget, memwatch)
 from .metrics import Histogram, MetricsRegistry, metrics
-from .openmetrics import ServerHandle, serve_metrics, to_openmetrics
+from .openmetrics import (ServerHandle, fleet_to_openmetrics,
+                          serve_metrics, to_openmetrics)
 from .profiler import (HostProfiler, KernelLedger, capture_snapshot,
                        configure_profiler, ledger, maybe_device_capture,
                        profiler, start_profiler, stop_profiler)
 from .recorder import FlightRecorder, install_excepthook, recorder
-from .slo import (SLObjective, SLOMonitor, default_objectives, monitor,
-                  principal_objectives)
+from .slo import (SLObjective, SLOMonitor, default_objectives,
+                  evaluate_fleet, monitor, principal_objectives)
+from .spool import (SPOOL_VERSION, SpoolError, read_spool,
+                    spool_snapshot, write_spool)
 from .timeseries import (Sampler, TimeSeriesStore, configure_sampler,
                          sampler, start_sampler, stop_sampler,
                          timeseries)
@@ -104,6 +118,7 @@ __all__ = [
     "record_command", "record_error", "device_trace",
     "TraceContext", "new_trace", "root_trace", "current_trace",
     "current_trace_id", "traced", "install_thread_propagation",
+    "parse_traceparent", "make_traceparent", "link_traceparent",
     "FlightRecorder", "recorder", "install_excepthook",
     "install_jax_listeners", "sample_memory", "STORM_THRESHOLD",
     "record_cost_analysis", "last_watermarks",
@@ -112,7 +127,11 @@ __all__ = [
     "TimeSeriesStore", "timeseries", "Sampler", "start_sampler",
     "stop_sampler", "sampler", "configure_sampler",
     "SLObjective", "SLOMonitor", "monitor", "default_objectives",
-    "principal_objectives",
+    "principal_objectives", "evaluate_fleet",
+    "SPOOL_VERSION", "SpoolError", "read_spool", "spool_snapshot",
+    "write_spool",
+    "FleetAggregator", "FleetStore", "WorkerState", "aggregator_for",
+    "fleet_to_openmetrics",
     "DeviceMonitor", "devicemon", "mesh_device_keys",
     "serve_dashboard",
     "HostProfiler", "KernelLedger", "ledger", "profiler",

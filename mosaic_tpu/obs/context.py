@@ -21,6 +21,19 @@ default; :func:`install_thread_propagation` (installed once at
 *while a trace is active* inherits the spawner's context snapshot.
 Threads spawned with no active trace are started untouched, so
 unrelated machinery (jax pools, test runners) sees zero change.
+
+Cross-PROCESS propagation speaks W3C ``traceparent``
+(``00-<32 hex trace>-<16 hex parent span>-<2 hex flags>``).  Local
+trace ids keep their ``t<pid>-<seq>`` shape — processes can't share a
+counter — so linking is by annotation, not id rewriting:
+:func:`link_traceparent` parks a validated incoming header in a
+ContextVar, the next :func:`new_trace` consumes it onto the context's
+``w3c_trace``/``w3c_parent`` fields and emits a ``trace_link``
+flight-recorder event, and the fleet aggregator stitches every local
+trace that recorded a link to the same W3C id into one tree.
+:func:`make_traceparent` renders the outgoing header for the active
+trace (reusing the linked W3C trace id when there is one, else
+deriving one deterministically from the local id).
 """
 
 from __future__ import annotations
@@ -28,15 +41,18 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import functools
+import hashlib
 import itertools
 import os
+import re
 import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 __all__ = ["TraceContext", "new_trace", "root_trace", "current_trace",
            "current_trace_id", "next_span_id", "traced",
-           "install_thread_propagation", "thread_trace_map"]
+           "install_thread_propagation", "thread_trace_map",
+           "parse_traceparent", "make_traceparent", "link_traceparent"]
 
 _trace_ids = itertools.count(1)
 _span_ids = itertools.count(1)
@@ -50,10 +66,15 @@ def next_span_id() -> int:
 @dataclass(frozen=True)
 class TraceContext:
     """One trace: a process-unique id plus a human-readable name
-    (``sql:SELECT ...``, ``ingest:shapefile``, ``bench``)."""
+    (``sql:SELECT ...``, ``ingest:shapefile``, ``bench``).  When the
+    trace was opened under :func:`link_traceparent`, ``w3c_trace`` /
+    ``w3c_parent`` carry the caller's W3C ids — the cross-process
+    stitching key; both stay None for purely local traces."""
 
     trace_id: str
     name: str
+    w3c_trace: Optional[str] = None
+    w3c_parent: Optional[str] = None
 
 
 _CTX: "contextvars.ContextVar[Optional[TraceContext]]" = \
@@ -84,15 +105,99 @@ def current_trace_id() -> Optional[str]:
     return ctx.trace_id if ctx is not None else None
 
 
+# ------------------------------------- W3C traceparent (cross-process)
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+#: Incoming link parked by :func:`link_traceparent`, consumed by the
+#: next :func:`new_trace` in the same context.
+_PENDING_LINK: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("mosaic_pending_trace_link", default=None)
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """Validate a W3C ``traceparent`` header -> ``(trace_id,
+    parent_span_id)`` hex pair, or None when absent/malformed (the
+    spec says ignore, never error: a bad header from a client must not
+    fail the request).  All-zero ids and the reserved version ``ff``
+    are invalid per spec."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if not m:
+        return None
+    version, trace_id, span_id, _flags = m.groups()
+    if version == "ff" or set(trace_id) == {"0"} or \
+            set(span_id) == {"0"}:
+        return None
+    return trace_id, span_id
+
+
+def _derived_w3c_ids(local_trace_id: str) -> Tuple[str, str]:
+    """Deterministic (trace, span) hex ids for a local trace that has
+    no incoming W3C link — same local id always maps to the same W3C
+    ids, so retries of the same derivation agree across call sites."""
+    digest = hashlib.sha256(local_trace_id.encode()).hexdigest()
+    return digest[:32], digest[32:48]
+
+
+def make_traceparent(ctx: Optional[TraceContext] = None
+                     ) -> Optional[str]:
+    """Render the outgoing ``traceparent`` for ``ctx`` (default: the
+    active trace; None when no trace is active).  A linked trace keeps
+    the caller's W3C trace id so the whole cross-process tree shares
+    one id; an unlinked trace derives a stable one from the local id.
+    The span id is this process's own — it becomes the downstream
+    side's ``w3c_parent``."""
+    ctx = ctx if ctx is not None else _CTX.get()
+    if ctx is None:
+        return None
+    trace_hex, span_hex = _derived_w3c_ids(ctx.trace_id)
+    if ctx.w3c_trace:
+        trace_hex = ctx.w3c_trace
+    return f"00-{trace_hex}-{span_hex}-01"
+
+
+@contextlib.contextmanager
+def link_traceparent(header: Optional[str]):
+    """Park an incoming ``traceparent`` so the next :func:`new_trace`
+    under this context links to it.  Invalid/absent headers are a
+    no-op (the trace opens unlinked).  Yields the parsed ``(trace,
+    parent span)`` pair or None."""
+    link = parse_traceparent(header)
+    token = _PENDING_LINK.set(link) if link else None
+    try:
+        yield link
+    finally:
+        if token is not None:
+            _PENDING_LINK.reset(token)
+
+
 @contextlib.contextmanager
 def new_trace(name: str):
-    """Open a fresh trace context (always a new trace id)."""
+    """Open a fresh trace context (always a new trace id).  If an
+    incoming ``traceparent`` was parked by :func:`link_traceparent`,
+    this trace consumes it (one link -> one trace): the W3C ids land
+    on the context and a ``trace_link`` event lands in the flight
+    recorder so fleet-level stitching can reunite the pieces."""
+    link = _PENDING_LINK.get()
     ctx = TraceContext(
-        trace_id=f"t{os.getpid()}-{next(_trace_ids):05d}", name=name)
+        trace_id=f"t{os.getpid()}-{next(_trace_ids):05d}", name=name,
+        w3c_trace=link[0] if link else None,
+        w3c_parent=link[1] if link else None)
     token = _CTX.set(ctx)
+    if link:
+        _PENDING_LINK.set(None)   # consumed: one link, one trace
     ident = threading.get_ident()
     prev = _THREAD_TRACES.get(ident)
     _THREAD_TRACES[ident] = ctx
+    if link:
+        # lazy import: recorder imports this module at top level
+        from .recorder import recorder
+        recorder.record("trace_link", w3c_trace=link[0],
+                        w3c_parent=link[1], name=name)
     try:
         yield ctx
     finally:

@@ -200,6 +200,36 @@ def _server_payload() -> Dict[str, object]:
                 "error": f"{type(exc).__name__}: {exc}"}
 
 
+def _fleet_payload(qs: Dict[str, list]) -> Dict[str, object]:
+    """The fleet panel: merged cross-worker view from the spool dir
+    (``?dir=`` overrides ``mosaic.obs.fleet.dir``).  ``?bundle=1``
+    returns the full fleet bundle (stitched traces + every worker's
+    recent events) instead of the summary view.  No spool dir
+    configured -> ``{"enabled": False}``, same stand-alone contract as
+    the server panel."""
+    from .. import config as _config
+    directory = (qs.get("dir") or [""])[0] or \
+        _config.default_config().obs_fleet_dir
+    if not directory:
+        return {"enabled": False}
+    from .fleet import aggregator_for
+    agg = aggregator_for(directory)
+    try:
+        view = agg.scan()
+        if (qs.get("bundle") or [""])[0] in ("1", "true"):
+            return dict(agg.bundle(view), enabled=True)
+        traces = agg.stitched_traces(view)
+        return {"enabled": True,
+                "fleet": view.payload(),
+                "slo_fleet": agg.evaluate_slo(view),
+                "traces": {tid: {"workers": t["workers"],
+                                 "spans": len(t["spans"])}
+                           for tid, t in traces.items()}}
+    except Exception as exc:      # a broken spool dir must not 500
+        return {"enabled": True, "dir": directory,
+                "error": f"{type(exc).__name__}: {exc}"}
+
+
 def _profile_payload(qs: Dict[str, list]) -> Dict[str, object]:
     from .profiler import ledger, profiler
     trace = (qs.get("trace") or [None])[0] or None
@@ -547,6 +577,8 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     self._json(_principals_payload())
                 elif path == "/api/server":
                     self._json(_server_payload())
+                elif path == "/api/fleet":
+                    self._json(_fleet_payload(qs))
                 elif _CANCEL_RE.match(path):
                     # cancel mutates: POST-only, so a prefetching
                     # browser/crawler can never kill a query

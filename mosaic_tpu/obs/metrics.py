@@ -203,5 +203,27 @@ class MetricsRegistry:
                                for n, h in self._hists.items()},
             }
 
+    def full_snapshot(self) -> Dict[str, object]:
+        """Consistent raw-state copy for the fleet spool: unlike
+        :meth:`report` the histograms carry their BUCKET COUNTS, so an
+        aggregator can merge N processes bucket-wise and reproduce
+        p50/p95/p99 exactly (identical exponential buckets everywhere
+        — same ``_NBUCKETS``/``_PER_OCTAVE``; only ``scale`` varies
+        per histogram and travels in the snapshot).  One lock hold for
+        the whole copy: no torn counter-vs-histogram view."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    n: {"scale": h.scale,
+                        "counts": list(h.counts),
+                        "count": h.count,
+                        "sum": h.sum,
+                        "min": h.min if h.count else 0.0,
+                        "max": h.max}
+                    for n, h in self._hists.items()},
+            }
+
 
 metrics = MetricsRegistry()

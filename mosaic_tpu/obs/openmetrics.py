@@ -38,7 +38,8 @@ from typing import List, Optional
 
 from .metrics import MetricsRegistry, _bucket_upper, metrics
 
-__all__ = ["to_openmetrics", "serve_metrics", "ServerHandle"]
+__all__ = ["to_openmetrics", "fleet_to_openmetrics", "serve_metrics",
+           "ServerHandle"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -137,6 +138,60 @@ def to_openmetrics(registry: Optional[MetricsRegistry] = None) -> str:
         lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
         lines.append(f"{m}_count {h.count}")
         lines.append(f"{m}_sum {_fmt(h.sum)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def fleet_to_openmetrics(view) -> str:
+    """Render a :class:`~.fleet.FleetView` as one exposition: counters
+    and gauges become labeled families with one ``{worker="<pid>"}``
+    sample per contributing worker (a scraper re-derives the fleet sum
+    / max with plain label aggregation — and can tell WHICH worker is
+    hot), while histograms render from the aggregator's bucket-wise
+    EXACT merge, unlabeled: per-worker quantiles still live on each
+    worker's own ``/metrics``, but a fleet p99 computed any other way
+    would be an approximation.  Stale workers keep their counter
+    samples (completed work stands) and lose their gauge samples,
+    mirroring the aggregator's merge rules."""
+    lines: List[str] = []
+    counters: dict = {}        # name -> [(pid, value)]
+    gauges: dict = {}
+    for w in view.workers:
+        if not w.readable:
+            continue
+        reg = (w.snapshot or {}).get("metrics", {})
+        for name, v in reg.get("counters", {}).items():
+            counters.setdefault(name, []).append((w.pid, v))
+        if not w.stale:
+            for name, v in reg.get("gauges", {}).items():
+                gauges.setdefault(name, []).append((w.pid, v))
+    for name, samples in sorted(counters.items()):
+        m = _sanitize(name) + "_total"
+        lines.append(f"# TYPE {m} counter")
+        for pid, v in sorted(samples):
+            lines.append(f'{m}{{worker="{pid}"}} {_fmt(v)}')
+    for name, samples in sorted(gauges.items()):
+        m = _sanitize(name)
+        lines.append(f"# TYPE {m} gauge")
+        for pid, v in sorted(samples):
+            lines.append(f'{m}{{worker="{pid}"}} {_fmt(v)}')
+    for name, h in sorted(view.histograms.items()):
+        m = _sanitize(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for i, c in enumerate(h.counts):
+            if c:
+                cum += c
+                le = _fmt(_bucket_upper(i, h.scale))
+                lines.append(f'{m}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{m}_count {h.count}")
+        lines.append(f"{m}_sum {_fmt(h.sum)}")
+    lines.append("# TYPE mosaic_fleet_workers gauge")
+    lines.append(f"mosaic_fleet_workers {len(view.workers)}")
+    lines.append("# TYPE mosaic_fleet_stale_workers gauge")
+    lines.append("mosaic_fleet_stale_workers "
+                 f"{sum(1 for w in view.workers if w.stale)}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

@@ -68,6 +68,9 @@ EVENTS = frozenset({
     "mem_admit_denied", "mem_chunk_shrink", "mem_leak",
     # query service (serve/): overload shedding + drain lifecycle
     "serve_shed", "serve_drain",
+    # fleet telemetry plane (spool/fleet): cross-process trace links
+    # and aggregator degrade paths
+    "trace_link", "fleet_worker_stale", "fleet_merge_error",
     # SLO + profiler
     "slo_breach", "slo_recovered", "profiler",
     # pipeline observer hook failures

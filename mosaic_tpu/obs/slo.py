@@ -48,7 +48,7 @@ from .timeseries import TimeSeriesStore, timeseries
 
 __all__ = ["SLObjective", "SLOMonitor", "monitor",
            "default_objectives", "principal_objectives",
-           "serve_objectives", "KINDS"]
+           "serve_objectives", "evaluate_fleet", "KINDS"]
 
 KINDS = ("latency", "error_rate", "counter_rate", "gauge_max")
 
@@ -311,6 +311,29 @@ class SLOMonitor:
                 "active": [dict(v) for v in self._breached.values()],
                 "breaches": self._breach_count,
             }
+
+
+def evaluate_fleet(store, objectives: Optional[List[SLObjective]] = None,
+                   now: Optional[float] = None) -> List[Dict[str, object]]:
+    """Fleet-level burn-rate evaluation: run every objective against a
+    merged store (any object with the TimeSeriesStore windowed-read
+    API — :class:`~.fleet.FleetStore` in practice; objectives are
+    duck-typed over it already).  Stateless by design: breach-episode
+    bookkeeping (alert once, recover once) stays with each worker's
+    own :class:`SLOMonitor`; the fleet answer is "is the FLEET burning
+    budget right now", recomputed per call.  Returns one result dict
+    per objective, bad objectives skipped the way the monitor skips
+    them."""
+    now = time.time() if now is None else now
+    objs = list(objectives) if objectives is not None \
+        else default_objectives()
+    out: List[Dict[str, object]] = []
+    for obj in objs:
+        try:
+            out.append(obj.evaluate(store, now))
+        except Exception:
+            continue              # same contract as SLOMonitor.evaluate
+    return out
 
 
 #: the process-global monitor the sampler drives

@@ -376,6 +376,15 @@ class Sampler:
             monitor.evaluate(now=now)
         except Exception:
             pass
+        # fleet spool: export this process's telemetry for the
+        # aggregator.  write_spool() is a no-op when mosaic.obs.fleet.
+        # dir is unset and swallows its own I/O errors, but the tick
+        # must survive even an import-time surprise
+        try:
+            from .spool import write_spool
+            write_spool(now=now)
+        except Exception:
+            pass
         self.ticks += 1
 
     @property

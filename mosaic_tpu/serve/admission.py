@@ -63,13 +63,17 @@ class ServeRequest:
     disconnect, server deadline) to the inflight ticket."""
 
     def __init__(self, sql: str, principal: str, priority: int = 0,
-                 deadline_ms: float = 0.0, lookup=None):
+                 deadline_ms: float = 0.0, lookup=None,
+                 traceparent: Optional[str] = None):
         import concurrent.futures
         self.sql = sql
         self.label = " ".join(sql.split())[:60]
         self.principal = principal
         self.priority = int(priority)
         self.deadline_ms = float(deadline_ms)
+        #: the client's W3C traceparent header, if it sent one — the
+        #: worker links the query's trace to it (cross-process trees)
+        self.traceparent = traceparent
         #: engine.BatchableLookup when the query may micro-batch
         self.lookup = lookup
         self.seq = next(_seq)

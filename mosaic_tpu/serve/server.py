@@ -387,8 +387,12 @@ class QueryServer:
         lookup = classify_batchable(sql, self.session,
                                     max_rows=self._batch_rows_max) \
             if self.pool.batch_max > 0 else None
+        # W3C cross-process trace propagation: a malformed header is
+        # ignored per spec (the request still runs, unlinked)
+        traceparent = headers.get("traceparent", "").strip() or None
         req = ServeRequest(sql, principal, priority=priority,
-                           deadline_ms=deadline_ms, lookup=lookup)
+                           deadline_ms=deadline_ms, lookup=lookup,
+                           traceparent=traceparent)
         deny = self.queue.offer(req, est_bytes=self._est_bytes(sql))
         if deny is not None:
             await self._respond_json(
@@ -407,11 +411,36 @@ class QueryServer:
             self._observe_request(principal, outcome or "disconnect",
                                   t0)
             return
+        trace_headers = self._trace_headers(req)
         if isinstance(payload, Table):
-            await self._stream_table(writer, payload)
+            await self._stream_table(writer, payload,
+                                     extra=trace_headers)
         else:
-            await self._respond_json(writer, status, payload)
+            await self._respond_json(writer, status, payload,
+                                     extra=trace_headers)
         self._observe_request(principal, outcome, t0)
+
+    @staticmethod
+    def _trace_headers(req: ServeRequest):
+        """Response trace headers for one served query: the W3C
+        ``traceparent`` (the client's trace id when it sent one, else
+        one derived from the worker's local trace; the span id is this
+        server's own — derived exactly the way the worker derives it,
+        so client-side logs and the fleet bundle name the same span)
+        plus ``X-Mosaic-Trace`` with the worker-local trace id the
+        flight recorder / dashboard key off."""
+        ticket = req.ticket
+        local = getattr(ticket, "trace_id", None) if ticket else None
+        if not local:
+            return None
+        from ..obs.context import (TraceContext, make_traceparent,
+                                   parse_traceparent)
+        link = parse_traceparent(req.traceparent)
+        hdr = make_traceparent(TraceContext(
+            trace_id=local, name=req.label,
+            w3c_trace=link[0] if link else None,
+            w3c_parent=link[1] if link else None))
+        return [("traceparent", hdr), ("X-Mosaic-Trace", local)]
 
     def _observe_request(self, principal: str, outcome: str,
                          t0: float) -> None:
@@ -477,7 +506,8 @@ class QueryServer:
         writer.write(body)
         await writer.drain()
 
-    async def _stream_table(self, writer, table: Table) -> None:
+    async def _stream_table(self, writer, table: Table,
+                            extra=None) -> None:
         """200 + JSON-lines: a header object, then row chunks.  Each
         chunk drains the socket, so a torn connection surfaces (and
         stops the serialization work) within one chunk."""
@@ -485,7 +515,7 @@ class QueryServer:
         head = json.dumps({"columns": names, "rows": len(table)},
                           default=_json_default).encode() + b"\n"
         await self._write_head(writer, 200, "application/jsonl",
-                               None, None, False)
+                               None, extra, False)
         writer.write(head)
         try:
             cols = [table.columns[n] for n in names]

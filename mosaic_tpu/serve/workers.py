@@ -21,6 +21,7 @@ import time
 from typing import List
 
 from ..obs import metrics
+from ..obs.context import link_traceparent
 from ..obs.inflight import QueryCancelled, checkpoint, ticket_observer
 from ..resilience import faults
 from ..resilience.faults import InjectedFault
@@ -129,7 +130,13 @@ class WorkerPool:
         self._run_single(req)
 
     def _run_single(self, req: ServeRequest) -> None:
-        with ticket_observer(req.attach_ticket):
+        # link_traceparent parks the client's W3C trace context so the
+        # engine's new_trace stitches this query into the caller's
+        # cross-process tree (no-op when the client sent no header).
+        # The micro-batch path skips linking: one device launch serves
+        # many clients, and a batch trace has no single parent.
+        with link_traceparent(req.traceparent), \
+                ticket_observer(req.attach_ticket):
             try:
                 out = self.session.sql(req.sql)
             except QueryCancelled as exc:

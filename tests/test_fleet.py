@@ -1,0 +1,345 @@
+"""The fleet telemetry plane (``obs/spool.py`` + ``obs/fleet.py``).
+
+The exactness contract is the headline: merging N per-worker spools
+bucket-wise must reproduce — bit-for-bit — the counters, sums, and
+p50/p95/p99 one registry fed every sample would report.  Around it,
+the degrade paths the ISSUE names: a torn spool (partial JSON), an
+alien ``SPOOL_VERSION``, and a stale worker each mark the view and
+record an event instead of raising; fleet SLO burn rates evaluate
+over re-hydrated per-worker series (rate = SUM of per-worker rates);
+``stitched_traces`` reunites spans spooled by different pids under
+one W3C trace id; and the operator surfaces (fleetctl, OpenMetrics
+exposition, dashboard panel) render the merged view.
+"""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.obs import metrics
+from mosaic_tpu.obs.fleet import (FleetAggregator, FleetStore,
+                                  aggregator_for)
+from mosaic_tpu.obs.recorder import recorder
+from mosaic_tpu.obs.slo import SLObjective, evaluate_fleet, monitor
+from mosaic_tpu.obs.spool import (SPOOL_VERSION, SpoolError, read_spool,
+                                  spool_path, spool_snapshot,
+                                  write_spool)
+from mosaic_tpu.obs.timeseries import timeseries
+
+
+@pytest.fixture
+def fleet_env():
+    """Clean obs singletons + config around each fleet test."""
+    prev = _config.default_config()
+    metrics.reset()
+    metrics.enable()
+    recorder.reset()
+    recorder.enable()
+    timeseries.reset()
+    monitor.reset()
+    yield
+    _config.set_default_config(prev)
+    metrics.disable()
+    metrics.reset()
+    recorder.reset()
+    timeseries.reset()
+    monitor.reset()
+
+
+def _write_worker(directory, pid, feed):
+    """Spool one fabricated worker: reset the registry, run ``feed``
+    against it, snapshot through the real spool machinery, and write
+    the file under the fabricated pid."""
+    metrics.reset()
+    feed(metrics)
+    snap = spool_snapshot()
+    snap["pid"] = pid
+    path = spool_path(str(directory), pid)
+    os.makedirs(str(directory), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(snap, fh)
+    return path
+
+
+# ------------------------------------------------- the exactness property
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_merge_equals_single_registry(tmp_path, fleet_env, seed):
+    """Property: aggregating N worker spools is indistinguishable from
+    one registry that saw every sample — counters and histogram count/
+    sum/min/max/p50/p95/p99 all bit-equal."""
+    rng = random.Random(seed)
+    n_workers = rng.randint(2, 5)
+    hists = [("q/wall_ms", 1e-6), ("q/bytes", 1.0)]
+    counters = ["sql/queries", "sql/errors", "serve/admitted"]
+    all_samples = {n: [] for n, _ in hists}
+    all_counts = {n: 0.0 for n in counters}
+    for i in range(n_workers):
+        samples = {n: [rng.lognormvariate(3.0, 2.0)
+                       for _ in range(rng.randint(5, 200))]
+                   for n, _ in hists}
+        counts = {n: float(rng.randint(0, 50)) for n in counters}
+
+        def feed(reg, samples=samples, counts=counts):
+            for (name, scale) in hists:
+                for v in samples[name]:
+                    reg.observe(name, v, scale=scale)
+            for name, v in counts.items():
+                if v:
+                    reg.count(name, v)
+
+        _write_worker(tmp_path, 50_000 + i, feed)
+        for n, _ in hists:
+            all_samples[n].extend(samples[n])
+        for n in counters:
+            all_counts[n] += counts[n]
+
+    view = FleetAggregator(str(tmp_path)).scan()
+    assert view.merge_errors == 0
+    assert len(view.workers) == n_workers
+
+    # the oracle: one registry fed every sample
+    metrics.reset()
+    for name, scale in hists:
+        for v in all_samples[name]:
+            metrics.observe(name, v, scale=scale)
+    for name, v in all_counts.items():
+        if v:
+            metrics.count(name, v)
+    oracle = metrics.full_snapshot()
+
+    for name, v in oracle["counters"].items():
+        assert view.counters[name] == v          # bit-equal, not approx
+    for name, _ in hists:
+        want = metrics.histogram(name).snapshot()
+        got = view.histograms[name].snapshot()
+        assert got["count"] == want["count"]
+        assert got["sum"] == pytest.approx(want["sum"], rel=1e-12)
+        assert got["min"] == want["min"]
+        assert got["max"] == want["max"]
+        for q in ("p50", "p95", "p99"):
+            assert got[q] == want[q], (name, q)
+        assert view.histograms[name].counts == \
+            metrics.histogram(name).counts
+
+
+# ------------------------------------------------------- spool mechanics
+
+def test_spool_roundtrip_and_unconfigured_noop(tmp_path, fleet_env):
+    assert write_spool() is None          # no dir configured: no-op
+    metrics.count("a/b", 3.0)
+    metrics.observe("a/ms", 1.5)
+    path = write_spool(str(tmp_path))
+    assert path == spool_path(str(tmp_path))
+    snap = read_spool(path)
+    assert snap["version"] == SPOOL_VERSION
+    assert snap["pid"] == os.getpid()
+    assert snap["metrics"]["counters"]["a/b"] == 3.0
+    assert snap["metrics"]["histograms"]["a/ms"]["count"] == 1
+    # the write itself is accounted
+    assert metrics.counter_value("fleet/spool_writes") == 1.0
+
+
+def test_spool_rides_sampler_tick(tmp_path, fleet_env):
+    from mosaic_tpu.obs.timeseries import Sampler
+    cfg = _config.apply_conf(_config.default_config(),
+                             "mosaic.obs.fleet.dir", str(tmp_path))
+    _config.set_default_config(cfg)
+    metrics.count("tick/works")
+    Sampler(1000.0, timeseries).tick(now=time.time())
+    snap = read_spool(spool_path(str(tmp_path)))
+    assert snap["metrics"]["counters"]["tick/works"] == 1.0
+
+
+def test_torn_spool_degrades_not_raises(tmp_path, fleet_env):
+    _write_worker(tmp_path, 50_001,
+                  lambda reg: reg.count("ok/seen", 7.0))
+    torn = spool_path(str(tmp_path), 50_002)
+    with open(torn, "w", encoding="utf-8") as fh:
+        fh.write('{"version": 1, "pid": 50002, "metri')   # mid-write
+    with pytest.raises(SpoolError):
+        read_spool(torn)
+    agg = FleetAggregator(str(tmp_path))
+    view = agg.scan()
+    assert view.merge_errors == 1
+    assert view.counters["ok/seen"] == 7.0    # good worker still merged
+    bad = [w for w in view.workers if w.pid == 50_002][0]
+    assert not bad.readable and "torn" in bad.error
+    evs = recorder.events("fleet_merge_error")
+    assert evs and evs[-1]["pid"] == 50_002
+
+
+def test_version_mismatch_degrades(tmp_path, fleet_env):
+    path = _write_worker(tmp_path, 50_003,
+                         lambda reg: reg.count("x/y", 1.0))
+    snap = json.load(open(path))
+    snap["version"] = 99
+    json.dump(snap, open(path, "w"))
+    view = FleetAggregator(str(tmp_path)).scan()
+    assert view.merge_errors == 1
+    assert "version" in view.workers[0].error
+    assert view.counters == {}
+
+
+def test_stale_worker_flagged_once_counters_kept(tmp_path, fleet_env):
+    fresh = _write_worker(
+        tmp_path, 50_010, lambda reg: (reg.count("work/done", 2.0),
+                                       reg.gauge("q/depth", 3.0)))
+    stale = _write_worker(
+        tmp_path, 50_011, lambda reg: (reg.count("work/done", 5.0),
+                                       reg.gauge("q/depth", 9.0)))
+    old = time.time() - 3600.0
+    os.utime(stale, (old, old))
+    os.utime(fresh, None)
+    agg = FleetAggregator(str(tmp_path), stale_ms=5_000.0)
+    view = agg.scan()
+    by_pid = {w.pid: w for w in view.workers}
+    assert by_pid[50_011].stale and not by_pid[50_010].stale
+    # counters sum over stale too (completed work doesn't un-happen)...
+    assert view.counters["work/done"] == 7.0
+    # ...but gauges come from FRESH workers only
+    assert view.gauges["q/depth"] == {"value": 3.0, "worker": 50_010}
+    # one event per stale TRANSITION, not per scan
+    agg.scan()
+    agg.scan()
+    evs = recorder.events("fleet_worker_stale")
+    assert len(evs) == 1 and evs[0]["pid"] == 50_011
+
+
+def test_histogram_scale_mismatch_skipped(tmp_path, fleet_env):
+    _write_worker(tmp_path, 50_020,
+                  lambda reg: reg.observe("h/ms", 5.0, scale=1e-6))
+    _write_worker(tmp_path, 50_021,
+                  lambda reg: reg.observe("h/ms", 5.0, scale=1.0))
+    view = FleetAggregator(str(tmp_path)).scan()
+    assert view.merge_errors == 1
+    # first worker's histogram survives un-poisoned
+    assert view.histograms["h/ms"].count == 1
+    assert "scale" in recorder.events("fleet_merge_error")[-1]["why"]
+
+
+# --------------------------------------------------- series + fleet SLO
+
+def test_fleet_rates_sum_and_slo_evaluates(tmp_path, fleet_env):
+    """Counter rate over the fleet = sum of per-worker rates, and a
+    counter_rate objective breaches on the SUM even when every single
+    worker is individually under its ceiling."""
+    now = time.time()
+    per_worker_rate = 1.5            # events/s each, over 60 s
+    for i, pid in enumerate((50_030, 50_031, 50_032)):
+        timeseries.reset()
+        for k in range(7):
+            t = now - 60.0 + k * 10.0
+            timeseries.record("jax/recompiles",
+                              per_worker_rate * (60.0 - (now - t)), t)
+
+        def feed(reg):
+            reg.count("jax/recompiles", per_worker_rate * 60.0)
+
+        _write_worker(tmp_path, pid, feed)
+    timeseries.reset()
+    agg = FleetAggregator(str(tmp_path))
+    view = agg.scan()
+    store = agg.fleet_store(view)
+    assert isinstance(store, FleetStore)
+    got = store.rate("jax/recompiles", 60.0, now)
+    assert got == pytest.approx(3 * per_worker_rate, rel=0.05)
+    obj = SLObjective(name="recompile_fleet", kind="counter_rate",
+                      series="jax/recompiles", max_rate=2.0,
+                      windows=(60.0, 60.0))
+    rows = evaluate_fleet(store, objectives=[obj], now=now)
+    assert rows[0]["breached"]       # 4.5/s fleet-wide > 2.0 ceiling
+    solo = SLObjective(name="recompile_solo", kind="counter_rate",
+                       series="jax/recompiles", max_rate=2.0,
+                       windows=(60.0, 60.0))
+    one = FleetStore({50_030: {
+        "jax/recompiles": store._workers[50_030]["jax/recompiles"]}})
+    assert not evaluate_fleet(one, objectives=[solo],
+                              now=now)[0]["breached"]
+
+
+# --------------------------------------------------- stitched traces
+
+def test_stitched_traces_across_pids(tmp_path, fleet_env):
+    w3c = "0af7651916cd43dd8448eb211c80319c"
+
+    def feed_client(reg):
+        recorder.reset()
+        recorder.record("trace_link", trace="t50040-00001",
+                        w3c_trace=w3c, w3c_parent="b7ad6b7169203331",
+                        name="client:load")
+        recorder.record("span", trace="t50040-00001",
+                        name="client/request", span="s1", parent=None,
+                        dur_s=0.2)
+
+    def feed_server(reg):
+        recorder.reset()
+        recorder.record("trace_link", trace="t50041-00007",
+                        w3c_trace=w3c, w3c_parent="b7ad6b7169203331",
+                        name="sql:SELECT 1")
+        recorder.record("span", trace="t50041-00007",
+                        name="sql/query", span="s2", parent=None,
+                        dur_s=0.1)
+        recorder.record("span", trace="t99999-00001",
+                        name="unlinked/other", span="s3", parent=None,
+                        dur_s=0.1)
+
+    _write_worker(tmp_path, 50_040, feed_client)
+    _write_worker(tmp_path, 50_041, feed_server)
+    agg = FleetAggregator(str(tmp_path))
+    traces = agg.stitched_traces()
+    assert set(traces) == {w3c}
+    tree = traces[w3c]
+    assert sorted(tree["workers"]) == [50_040, 50_041]
+    names = {s["name"] for s in tree["spans"]}
+    assert names == {"client/request", "sql/query"}   # unlinked: out
+    assert {s["worker"] for s in tree["spans"]} == {50_040, 50_041}
+    bundle = agg.bundle()
+    assert bundle["reason"] == "fleet"
+    assert w3c in bundle["traces"]
+    assert set(bundle["events_by_worker"]) == {50_040, 50_041}
+
+
+# --------------------------------------------- operator surfaces
+
+def test_fleetctl_openmetrics_and_dashboard(tmp_path, fleet_env,
+                                            capsys):
+    _write_worker(tmp_path, 50_050,
+                  lambda reg: (reg.count("serve/admitted", 4.0),
+                               reg.observe("q/ms", 2.5)))
+    metrics.reset()
+
+    from mosaic_tpu.obs.openmetrics import fleet_to_openmetrics
+    view = FleetAggregator(str(tmp_path)).scan()
+    text = fleet_to_openmetrics(view)
+    assert 'worker="50050"' in text
+    assert "mosaic_fleet_workers 1" in text
+    assert text.endswith("# EOF\n")
+
+    import tools.fleetctl as fleetctl
+    assert fleetctl.main(["--dir", str(tmp_path), "list"]) == 0
+    out = capsys.readouterr().out
+    assert "50050" in out and "fresh" in out
+    assert fleetctl.main(["--dir", str(tmp_path), "alerts"]) == 0
+    assert fleetctl.main(
+        ["--dir", str(tmp_path), "bundle",
+         "--out", str(tmp_path / "b.json")]) == 0
+    assert json.load(open(tmp_path / "b.json"))["reason"] == "fleet"
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    assert fleetctl.main(["--dir", str(empty), "list"]) == 1
+
+    from mosaic_tpu.obs.dashboard import _fleet_payload
+    assert _fleet_payload({}) == {"enabled": False}
+    payload = _fleet_payload({"dir": [str(tmp_path)]})
+    assert payload["enabled"]
+    assert payload["fleet"]["counters"]["serve/admitted"] == 4.0
+
+
+def test_aggregator_for_is_cached(tmp_path, fleet_env):
+    a = aggregator_for(str(tmp_path))
+    assert aggregator_for(str(tmp_path)) is a

@@ -90,7 +90,7 @@ def _conf(**keys):
 
 
 def _post(port, sql, principal="t", priority=None, deadline_ms=None,
-          timeout=30.0):
+          timeout=30.0, traceparent=None):
     """POST /query; returns (status, headers dict, body bytes)."""
     conn = http.client.HTTPConnection("127.0.0.1", port,
                                       timeout=timeout)
@@ -100,6 +100,8 @@ def _post(port, sql, principal="t", priority=None, deadline_ms=None,
             headers["X-Mosaic-Priority"] = str(priority)
         if deadline_ms is not None:
             headers["X-Mosaic-Deadline-Ms"] = str(deadline_ms)
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         conn.request("POST", "/query", body=sql.encode(),
                      headers=headers)
         r = conn.getresponse()
@@ -163,6 +165,61 @@ def test_http_basics_and_bad_requests(session, serve_env):
                      headers={"Content-Type": "application/json"})
         assert conn.getresponse().status == 200
         conn.close()
+
+
+def test_traceparent_stitches_cross_process_trace(session, serve_env,
+                                                  tmp_path):
+    """A request carrying a W3C traceparent comes back with the SAME
+    trace id in its response header, the worker's local trace is
+    linked to it (trace_link event), and the fleet aggregator stitches
+    client + server spans into one tree under that id."""
+    from mosaic_tpu.obs.context import (link_traceparent, new_trace,
+                                        parse_traceparent)
+    from mosaic_tpu.obs.fleet import FleetAggregator
+    from mosaic_tpu.obs.spool import write_spool
+    from mosaic_tpu.obs.tracer import tracer
+    tracer.enable()
+    try:
+        w3c_trace = "4bf92f3577b34da6a3ce929d0e0e4736"
+        tp = f"00-{w3c_trace}-00f067aa0ba902b7-01"
+        with QueryServer(session, workers=2) as srv:
+            # the client half: link our own trace to the same header
+            # we send, exactly like tools/loadtest.py does
+            with link_traceparent(tp), new_trace("client:test"):
+                with tracer.span("client/request"):
+                    status, headers, body = _post(
+                        srv.port, "SELECT id FROM small LIMIT 3",
+                        traceparent=tp)
+        assert status == 200
+        # response echoes the caller's trace id with a server span id
+        parsed = parse_traceparent(headers.get("traceparent", ""))
+        assert parsed is not None and parsed[0] == w3c_trace
+        local = headers.get("X-Mosaic-Trace", "")
+        assert local.startswith("t")
+
+        # both sides linked their local trace to the one W3C id
+        links = [e for e in recorder.events("trace_link")
+                 if e["w3c_trace"] == w3c_trace]
+        linked_traces = {e["trace"] for e in links}
+        assert local in linked_traces          # server side
+        assert len(linked_traces) >= 2         # + client side
+        # ... and the linked server trace actually carries spans
+        spans = [e for e in recorder.events("span")
+                 if e.get("trace") == local]
+        assert spans, "linked query trace recorded no spans"
+
+        # spool this process and stitch through the fleet aggregator
+        assert write_spool(str(tmp_path)) is not None
+        agg = FleetAggregator(str(tmp_path))
+        traces = agg.stitched_traces(agg.scan())
+        assert w3c_trace in traces
+        tree = traces[w3c_trace]
+        stitched = {s["local_trace"] for s in tree["spans"]}
+        assert local in stitched and len(stitched) >= 2
+        assert any(s["name"] == "client/request"
+                   for s in tree["spans"])
+    finally:
+        tracer.disable()
 
 
 def test_stats_and_dashboard_payload(session, serve_env):

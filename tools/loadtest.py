@@ -35,9 +35,18 @@ from typing import Dict, List, Optional, Sequence, Tuple
 _HIST = "serve/client_ms"
 
 
+def _w3c_traceparent(rng) -> str:
+    """A fresh W3C traceparent from the client's RNG (all-zero ids
+    are invalid per spec, so re-roll the astronomically unlikely)."""
+    trace = rng.getrandbits(128) or 1
+    span = rng.getrandbits(64) or 1
+    return f"00-{trace:032x}-{span:016x}-01"
+
+
 def _post_query(host: str, port: int, sql: str, principal: str,
                 priority: int = 0, deadline_ms: float = 0.0,
-                timeout: float = 30.0) -> Tuple[int, str]:
+                timeout: float = 30.0,
+                traceparent: Optional[str] = None) -> Tuple[int, str]:
     """One POST /query on a fresh connection; returns (status,
     reason) where reason is the deny reason for 429s, "" otherwise."""
     import http.client
@@ -49,6 +58,8 @@ def _post_query(host: str, port: int, sql: str, principal: str,
             headers["X-Mosaic-Priority"] = str(priority)
         if deadline_ms > 0:
             headers["X-Mosaic-Deadline-Ms"] = str(deadline_ms)
+        if traceparent:
+            headers["traceparent"] = traceparent
         conn.request("POST", "/query", body=sql.encode(),
                      headers=headers)
         resp = conn.getresponse()
@@ -92,6 +103,9 @@ def run_loadtest(host: str, port: int,
     (default: one shared "loadtest" tenant).  Returns the aggregate
     report (see module docstring)."""
     from mosaic_tpu.obs import metrics
+    from mosaic_tpu.obs.context import link_traceparent, new_trace
+    from mosaic_tpu.obs.tracer import tracer
+    tracer.enable()               # client spans must exist to stitch
     metrics.enable()
     principals = list(principals or ["loadtest"])
     priority_of = priority_of or {}
@@ -120,11 +134,20 @@ def run_loadtest(host: str, port: int,
         t_end = time.perf_counter() + duration_s
         while time.perf_counter() < t_end:
             sql = pick(rng.random())
+            # every request carries a fresh W3C traceparent, and the
+            # client's own trace links to the SAME id — the server
+            # worker links its query trace to it too, so both sides'
+            # spans stitch into one cross-process tree in the fleet
+            # bundle (fleet.stitched_traces)
+            tp = _w3c_traceparent(rng)
             t0 = time.perf_counter()
             try:
-                status, reason = _post_query(
-                    host, port, sql, principal, priority=prio,
-                    deadline_ms=deadline_ms)
+                with link_traceparent(tp), \
+                        new_trace(f"client:{principal}"):
+                    with tracer.span("loadtest/request"):
+                        status, reason = _post_query(
+                            host, port, sql, principal, priority=prio,
+                            deadline_ms=deadline_ms, traceparent=tp)
             except Exception:
                 status, reason = -1, ""
             dt_ms = (time.perf_counter() - t0) * 1e3
