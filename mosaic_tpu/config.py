@@ -150,6 +150,19 @@ MOSAIC_OBS_FLEET_DIR = "mosaic.obs.fleet.dir"
 MOSAIC_OBS_FLEET_STALE_MS = "mosaic.obs.fleet.stale.ms"
 MOSAIC_OBS_FLEET_WINDOW_MS = "mosaic.obs.fleet.window.ms"
 MOSAIC_OBS_FLEET_EVENTS = "mosaic.obs.fleet.events"
+# Out-of-core chip store (mosaic_tpu/store/): default root directory
+# for grid-partitioned columnar stores ("" = no default; APIs take an
+# explicit path), the fixed world-grid resolution new stores partition
+# on (res x res cells over lon/lat — finer grids prune tighter but
+# carry more partitions in the manifest), the target rows per shard
+# file (a partition holding more rows splits into multiple shards so
+# one read never materializes an unbounded column), and whether the
+# reader memory-maps shard files (off copies each shard through a
+# normal read — slower, but immune to mmap-unfriendly filesystems).
+MOSAIC_STORE_DIR = "mosaic.store.dir"
+MOSAIC_STORE_GRID_RES = "mosaic.store.grid.res"
+MOSAIC_STORE_SHARD_ROWS = "mosaic.store.shard.rows"
+MOSAIC_STORE_MMAP = "mosaic.store.mmap"
 
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
@@ -275,6 +288,12 @@ class MosaicConfig:
     obs_fleet_stale_ms: float = 5_000.0
     obs_fleet_window_ms: float = 300_000.0
     obs_fleet_events: int = 512
+    # Out-of-core chip store — see the mosaic.store.* key comments
+    # above.  "" = no default store directory.
+    store_dir: str = ""
+    store_grid_res: int = 1_024
+    store_shard_rows: int = 4_194_304
+    store_mmap: bool = True
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -466,6 +485,10 @@ _CONF_FIELDS = {
     MOSAIC_OBS_FLEET_STALE_MS: ("obs_fleet_stale_ms", _as_millis),
     MOSAIC_OBS_FLEET_WINDOW_MS: ("obs_fleet_window_ms", _as_millis),
     MOSAIC_OBS_FLEET_EVENTS: ("obs_fleet_events", _as_count),
+    MOSAIC_STORE_DIR: ("store_dir", _as_str),
+    MOSAIC_STORE_GRID_RES: ("store_grid_res", _as_blocksize),
+    MOSAIC_STORE_SHARD_ROWS: ("store_shard_rows", _as_blocksize),
+    MOSAIC_STORE_MMAP: ("store_mmap", _as_flag),
 }
 
 

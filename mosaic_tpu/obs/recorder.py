@@ -75,6 +75,10 @@ EVENTS = frozenset({
     "slo_breach", "slo_recovered", "profiler",
     # pipeline observer hook failures
     "pipeline_observe_error",
+    # out-of-core chip store: torn-shard degrade (reader found fewer
+    # bytes on disk than the manifest promised and recovered per the
+    # on_error policy)
+    "store_shard_torn",
     # recorder-internal marks
     "dump", "dump_suppressed", "dump_suppressed_flush", "error",
     "unhandled_error",

@@ -642,6 +642,181 @@ def make_sharded_streamed_pip_join(idx, grid: IndexSystem, mesh,
     return run
 
 
+def make_store_sharded_pip_join(store, idx, grid: IndexSystem, mesh,
+                                polys: Optional[GeometryArray] = None,
+                                chunk: Optional[int] = None,
+                                eps: Optional[float] = None,
+                                margin_eps: Optional[float] = None,
+                                axis: str = "data",
+                                refresh: Optional[int] = None,
+                                nbins: int = 16):
+    """The sharded flagship fed from an out-of-core chip store.
+
+    Same three-layer pipeline as :func:`make_sharded_streamed_pip_join`
+    — double-buffered staging, bucketed kernel cache, skew-aware
+    placement — but the chunk source is
+    :meth:`~..store.reader.ChipStore.iter_chunks`: a GENERATOR that
+    prunes partitions against the query bbox from the manifest alone,
+    then reads one shard at a time off disk.  The host never holds
+    more than the pipeline's look-ahead window, so the dataset can be
+    arbitrarily larger than RAM; a pruned partition contributes ZERO
+    staged bytes (provable from ``run.staged_bytes_by_partition`` and
+    the memwatch ledger's ``pip_join/store/staged`` site).
+
+    Placement is PARTITION-level here: every row of a store partition
+    inherits the shard the :class:`.placement.SkewRebalancer` prefers
+    for that partition's bbox centroid, so the placement pass moves
+    whole partitions between devices instead of individual rows — the
+    granularity the store's on-disk layout already paid for (density
+    feedback still learns from every consumed row, as before).
+    Results stay bit-for-bit identical to the in-memory sharded path
+    over the same points in store order: placement and padding only
+    move *where* rows are computed, and the f64 host recheck is the
+    same authority.
+
+    Returns ``run(bbox=None) -> (zone [rows] int32, rechecked
+    count)`` over the scanned rows in store order (manifest partition
+    order, ingest order within a partition).  ``run.rebalancer``
+    exposes the placement pass; after each call
+    ``run.staged_bytes_by_partition`` maps cell id -> bytes that
+    partition's rows staged (row-proportional share of each chunk's
+    padded buffer)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..config import default_config
+    from ..obs import metrics
+    from ..perf.bucketing import pow2_bucket
+    from ..perf.jit_cache import kernel_cache
+    from .placement import SkewRebalancer, placement_slots
+
+    chunk = _resolve_chunk(chunk)
+    fn = make_pip_join_fn(idx, grid, eps, margin_eps)
+    recheck = host_recheck_fn(idx, polys)
+    origin = np.asarray(idx.origin)
+    D = mesh.shape[axis]
+    pts_sharding = NamedSharding(mesh, P(axis, None))
+    out_sharding = (NamedSharding(mesh, P(axis)),
+                    NamedSharding(mesh, P(axis)))
+    if refresh is None:
+        refresh = default_config().shard_skew_refresh
+    rebalancer = SkewRebalancer(D, refresh=refresh, nbins=nbins)
+    idx_bytes = sum(int(np.asarray(leaf).nbytes)
+                    for leaf in jax.tree_util.tree_leaves(idx))
+    # partition bbox centroids: the rebalancer's placement key — one
+    # preferred-shard query per partition span, not per row
+    cent = {p.cell: ((p.bbox[0] + p.bbox[2]) / 2.0,
+                     (p.bbox[1] + p.bbox[3]) / 2.0)
+            for p in store.partitions}
+
+    def kernel(rows):
+        # shares the in-memory sharded path's cache family: a store
+        # query and an array query of the same bucket reuse one compile
+        return kernel_cache.get_or_build(
+            "pip/sharded_stream",
+            (id(idx), id(mesh), axis, rows, eps, margin_eps),
+            lambda: jax.jit(fn, in_shardings=(pts_sharding,),
+                            out_shardings=out_sharding))
+
+    def run(bbox=None):
+        from ..obs import tracer
+        from ..obs.context import root_trace
+        from ..obs.inflight import checkpoint
+        checkpoint("pip_join/store")
+        state = {"rechecked": 0, "slots": {}, "weights": None}
+        staged_by_part: dict = {}
+        rows_total = 0
+
+        def put(ck):
+            rows = ck.rows
+            per = pow2_bucket(-(-rows // D), floor=64)
+            pref = None
+            if rebalancer.armed:
+                # whole-partition placement: each span's rows go where
+                # the rebalancer wants that partition's centroid
+                cpts = np.asarray([cent[c] for c, _ in ck.parts],
+                                  np.float64)
+                pref = np.repeat(rebalancer.preferred(cpts),
+                                 [r for _, r in ck.parts])
+            slots = placement_slots(pref, rows, D, per)
+            buf = np.full((per * D, 2), _PAD_SENTINEL_DEG, np.float32)
+            buf[slots] = (ck.points - origin[None]).astype(np.float32)
+            state["slots"][ck.offset] = slots
+            # per-partition staging ledger: this chunk's padded buffer
+            # split across its spans by row share (cumulative rounding
+            # so the shares sum EXACTLY to buf.nbytes — the ledger
+            # then reconciles against pipeline/h2d_bytes byte for
+            # byte).  A pruned partition never appears here: it never
+            # reached a chunk.
+            seen = acc = 0
+            for c, r in ck.parts:
+                seen += r
+                share = buf.nbytes * seen // rows - acc
+                acc += share
+                staged_by_part[c] = staged_by_part.get(c, 0) + share
+            return per * D, jax.device_put(buf, pts_sharding)
+
+        def compute(staged):
+            rows, dev = staged
+            return kernel(rows)(dev)
+
+        def consume(i, ck, host):
+            nonlocal rows_total
+            zp, up = host
+            zp = np.asarray(zp)
+            slots = state["slots"].pop(ck.offset)
+            z = zp[slots]
+            unc = np.asarray(up)[slots]
+            zone = recheck(ck.points, z, unc)
+            state["rechecked"] += int(unc.sum())
+            rows_total += ck.rows
+            # density feedback stays row-level (free: already on host)
+            rebalancer.observe(ck.points, z >= 0)
+            if metrics.enabled:
+                c = _shard_skew_readback(zp, D)
+                w = state.get("weights")
+                state["weights"] = c if w is None else w + c
+                metrics.gauge("shard/skew_planned/pip_join",
+                              rebalancer.planned_skew())
+            return zone
+
+        def observe(i, ck, seconds):
+            from ..obs.profiler import ledger
+            padded = pow2_bucket(-(-ck.rows // D), floor=64) * D
+            ledger.observe("pip/sharded_stream",
+                           (id(idx), id(mesh), axis, padded, eps,
+                            margin_eps), seconds, rows=ck.rows)
+
+        import time as _time
+        t0 = _time.perf_counter()
+        with root_trace("pip_join"), \
+                tracer.span("pip_join/store_streamed"):
+            zones = stream(store.iter_chunks(bbox=bbox,
+                                             chunk_rows=chunk),
+                           compute=compute, put=put, consume=consume,
+                           observe=observe, site="pip_join/store")
+        zone_out = np.concatenate(zones) if zones \
+            else np.empty(0, np.int32)
+        run.staged_bytes_by_partition = staged_by_part
+        if metrics.enabled:
+            from ..obs.devicemon import devicemon, mesh_device_keys
+            devicemon.attribute("pip_join",
+                                _time.perf_counter() - t0,
+                                state.get("weights"),
+                                mesh_device_keys(mesh))
+            metrics.gauge("collective/replicated_index_bytes",
+                          float(idx_bytes) * D)
+            metrics.gauge("shard/points_per_shard/pip_join",
+                          rows_total / D)
+            metrics.count("collective/points_scatter_bytes",
+                          8.0 * rows_total)
+            metrics.count("pip_join/store_points", float(rows_total))
+            metrics.count("pip_join/store_chunks", float(len(zones)))
+        return zone_out, state["rechecked"]
+
+    run.rebalancer = rebalancer
+    run.staged_bytes_by_partition = {}
+    return run
+
+
 def make_planned_pip_join(idx, grid: IndexSystem,
                           polys: Optional[GeometryArray] = None,
                           mesh=None,

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Iterable, List, Optional
 
 import numpy as np
 
@@ -49,6 +49,10 @@ _MAX_INFLIGHT_FETCHES = 2
 #: (guards against a pathological budget dissolving the stream into
 #: per-row launches)
 _MIN_SHRINK_ROWS = 64
+
+#: iterator-exhaustion sentinel for the lazy chunk pull (``None`` is a
+#: legal chunk payload, so exhaustion needs its own marker)
+_DONE = object()
 
 
 def _tree_bytes(x) -> int:
@@ -110,7 +114,7 @@ def staged_put(payload, site: str = "pipeline.staged",
     return dev, tok
 
 
-def stream(chunks: Sequence, compute: Callable,
+def stream(chunks: Iterable, compute: Callable,
            put: Optional[Callable] = None,
            consume: Optional[Callable] = None,
            observe: Optional[Callable] = None,
@@ -166,10 +170,16 @@ def stream(chunks: Sequence, compute: Callable,
     QueryCancelled` from the probe — propagate to the caller; the
     worker is drained first so no device work is abandoned mid-flight
     (the executor's ``with`` block joins the worker on the way out, so
-    a cancelled stream leaks no threads or in-flight device buffers)."""
-    chunks = list(chunks)
-    if not chunks:
-        return []
+    a cancelled stream leaks no threads or in-flight device buffers).
+
+    ``chunks`` may be any iterable — including a GENERATOR that
+    produces chunks lazily (the out-of-core chip store's scan path,
+    ``store.reader.ChipStore.iter_chunks``).  The pipeline never
+    materializes the chunk list: it pulls exactly one chunk ahead of
+    the running compute (the double-buffer look-ahead), so the host
+    working set stays bounded by the in-flight window regardless of
+    how many chunks — or how many bytes — the source will eventually
+    yield."""
     import time as _time
     import jax
     from ..obs.inflight import charge_d2h_bytes, checkpoint, inflight
@@ -219,18 +229,36 @@ def stream(chunks: Sequence, compute: Callable,
     def staged(payload):
         return staged_put(payload, site=f"{site}/staged", put=put)
 
-    def maybe_split(j):
+    # lazy source: chunks are pulled one at a time from the iterator —
+    # a split pushes its halves back onto the head of this small deque,
+    # so the pending window never holds more than one source chunk's
+    # worth of slices
+    source = iter(chunks)
+    pending: deque = deque()
+
+    def pull() -> bool:
+        """Ensure at least one chunk is pending; False when the source
+        is exhausted."""
+        if not pending:
+            nxt = next(source, _DONE)
+            if nxt is _DONE:
+                return False
+            pending.append(nxt)
+        return True
+
+    def maybe_split():
         # degrade-not-die: while any device sits past the pressure
         # high-water mark, halve the next chunk's rows before staging
-        # it.  Only row slices split (all streamed call sites chunk by
-        # slice); consumers key on the slice, so the extra boundaries
-        # are invisible in the results.
+        # it.  Only row slices split (the array-backed call sites chunk
+        # by slice); consumers key on the slice, so the extra
+        # boundaries are invisible in the results.
         while (mem_budget.shrink_needed()
-               and isinstance(chunks[j], slice)
-               and (chunks[j].stop - chunks[j].start) > _MIN_SHRINK_ROWS):
-            sl = chunks[j]
+               and pending and isinstance(pending[0], slice)
+               and (pending[0].stop - pending[0].start) > _MIN_SHRINK_ROWS):
+            sl = pending.popleft()
             mid = (sl.start + sl.stop) // 2
-            chunks[j:j + 1] = [slice(sl.start, mid), slice(mid, sl.stop)]
+            pending.appendleft(slice(mid, sl.stop))
+            pending.appendleft(slice(sl.start, mid))
             if metrics.enabled:
                 metrics.count("mem/chunk_shrink")
             if not obs_state["shrunk"]:   # flight-record once per stream
@@ -239,15 +267,17 @@ def stream(chunks: Sequence, compute: Callable,
                 recorder.record("mem_chunk_shrink", site=site,
                                 rows=sl.stop - sl.start)
 
+    if not pull():
+        return []
     results: list = []
     with ThreadPoolExecutor(max_workers=1) as pool:
         futs: deque = deque()
-        maybe_split(0)
-        dev, tok = staged(chunks[0])
+        maybe_split()
+        payload = pending.popleft()
+        dev, tok = staged(payload)
         try:
             i = 0
-            while i < len(chunks):  # len() re-read: splits grow it
-                payload = chunks[i]
+            while payload is not _DONE:
                 checkpoint("pipeline.stream")   # chunk-boundary cancel
                 # latency chaos: "pipeline.chunk" mode=delay stalls the
                 # dispatch loop (the cancellation drill's stall point —
@@ -260,14 +290,15 @@ def stream(chunks: Sequence, compute: Callable,
                     f"{site}/out", _tree_bytes(out),
                     devices=device_keys_of(out)) \
                     if memwatch.enabled else None
-                if i + 1 < len(chunks):
-                    maybe_split(i + 1)
-                    nxt = staged(chunks[i + 1])  # overlap H2D w/ compute
+                if pull():
+                    maybe_split()
+                    nxt_payload = pending.popleft()
+                    nxt = staged(nxt_payload)    # overlap H2D w/ compute
                 else:
-                    nxt = (None, None)
+                    nxt_payload, nxt = _DONE, (None, None)
                 futs.append(pool.submit(fetch, i, payload, out,
                                         dispatch_t, tok, tok_out))
-                dev, tok = nxt
+                (dev, tok), payload = nxt, nxt_payload
                 # bounded in-flight window: resolve the oldest fetch
                 # once the window fills, so host results and queued
                 # work items stop scaling with total stream length

@@ -35,7 +35,7 @@ from ..core.geometry.array import GeometryArray
 from ..obs import metrics, new_trace, recorder, tracer
 from ..obs.devicemon import devicemon, format_device_ms
 from .parser import (Binary, Call, Column, Literal, Query, SelectItem,
-                     Star, Unary, parse)
+                     Star, TableRef, Unary, parse)
 from .planner import planner
 
 GENERATORS = {"grid_tessellateexplode", "mosaic_explode",
@@ -183,6 +183,10 @@ class SQLSession:
         from ..functions.context import MosaicContext
         self.mc = context or MosaicContext.context()
         self._tables: Dict[str, Table] = {}
+        # out-of-core chip stores registered as scannable tables
+        # (mosaic_tpu/store/): a store scan prunes partitions against
+        # the WHERE clause's bbox before reading a data byte
+        self._stores: Dict[str, object] = {}
         # Accounting identity: queries from this session are metered
         # under this principal; None falls back to the
         # ``mosaic.principal`` conf, then "anonymous" (obs/accounting).
@@ -202,6 +206,40 @@ class SQLSession:
 
     def drop_table(self, name: str) -> None:
         self._tables.pop(name.lower(), None)
+
+    def register_store(self, name: str, store) -> None:
+        """Register a chip store (a path or an opened
+        :class:`~..store.reader.ChipStore`) as a scannable table.
+        Scans of it push the WHERE clause's bbox down into partition
+        pruning (EXPLAIN's ``partitions`` column shows scanned/total);
+        only the surviving partitions' rows materialize, in store
+        order.  An in-memory table of the same name shadows the
+        store."""
+        if isinstance(store, str):
+            from ..store.reader import ChipStore
+            store = ChipStore(store)
+        self._stores[name.lower()] = store
+
+    def drop_store(self, name: str) -> None:
+        self._stores.pop(name.lower(), None)
+
+    def _store_for(self, name: str):
+        """The store a table reference resolves to, or None (in-memory
+        tables shadow stores of the same name)."""
+        key = name.lower()
+        if key in self._tables:
+            return None
+        return self._stores.get(key)
+
+    def _store_scan(self, name: str, where) -> Table:
+        """Materialize a store scan: bbox pushdown from the WHERE
+        clause -> partition pruning -> read only the survivors.  The
+        WHERE still runs over the scanned rows downstream, so pruning
+        only has to be conservative, never exact."""
+        from ..store.pushdown import bbox_from_where
+        store = self._stores[name.lower()]
+        bbox = bbox_from_where(where, *store.point_cols)
+        return Table(store.read_columns(bbox=bbox))
 
     # -- query entry
     def sql(self, query: str) -> Table:
@@ -328,6 +366,19 @@ class SQLSession:
             def _est_bytes(o: str) -> int:
                 s = plan.steps.get(o) if plan is not None else None
                 return s.est_bytes if s is not None else -1
+
+            def _partitions(o: str) -> str:
+                # store scans show the bbox pushdown's pruning as
+                # "scanned/total" — computed from the manifest alone
+                # (EXPLAIN moves no data bytes); "-" everywhere else
+                store = self._store_for(q.table.name) \
+                    if o == "scan" and q.join is None else None
+                if store is None:
+                    return "-"
+                from ..store.pushdown import bbox_from_where
+                bbox = bbox_from_where(q.where, *store.point_cols)
+                scanned = len(store.prune(bbox, record=False))
+                return f"{scanned}/{len(store.partitions)}"
             # est_bytes: the planner's byte pre-pass (cardinality x
             # source row width; -1 = no estimate) — what the memory
             # budget's admission check reads
@@ -338,6 +389,8 @@ class SQLSession:
                           "est_bytes": np.asarray(
                               [_est_bytes(o) for o, _ in ops],
                               np.int64),
+                          "partitions": [_partitions(o)
+                                         for o, _ in ops],
                           "fused": [fplan.gid_for(o) if fplan is not None
                                     else "-" for o, _ in ops]})
         if q.explain == "analyze":
@@ -626,12 +679,23 @@ class SQLSession:
         return out
 
     # -- FROM / JOIN
+    def _scan_source(self, ref: TableRef, where) -> Table:
+        """One FROM side: in-memory table, or a registered chip store
+        scan.  ``where`` enables bbox pushdown — passed only for the
+        single-table scan (a join's WHERE filters post-join rows, so
+        pushing it into a side is not generally sound; joined store
+        sides full-scan)."""
+        if self._store_for(ref.name) is not None:
+            return self._store_scan(ref.name, where)
+        return self.table(ref.name)
+
     def _from_clause(self, q: Query) -> _Env:
-        left = self.table(q.table.name)
+        left = self._scan_source(q.table,
+                                 q.where if q.join is None else None)
         lq = (q.table.alias or q.table.name).lower()
         if q.join is None:
             return _Env({lq: left})
-        right = self.table(q.join.name)
+        right = self._scan_source(q.join, None)
         rq = (q.join.alias or q.join.name).lower()
         if lq == rq:
             raise SQLError(f"self-join needs distinct aliases "

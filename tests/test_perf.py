@@ -264,6 +264,50 @@ def test_stream_ordering_and_consume():
     assert stream([], compute=fn) == []
 
 
+def test_stream_accepts_generator_source():
+    """Regression: ``stream`` must accept a LAZY chunk iterator (the
+    chip store's scan path) — same results as a list source, pulled at
+    most one chunk ahead of the running compute (the double-buffer
+    window), and never materialized into a list."""
+    import jax
+    import jax.numpy as jnp
+    n, chunk = 1000, 128
+    x = np.arange(n, dtype=np.float64)
+    slices = chunk_rows(n, chunk)
+    fn = jax.jit(lambda v: v * 2.0)
+    pulled = {"n": 0}
+
+    def gen():
+        for sl in slices:
+            pulled["n"] += 1
+            yield sl
+
+    computed = {"n": 0}
+    window = []
+
+    def compute(dev):
+        computed["n"] += 1
+        # bounded look-ahead: at the i-th compute, the source has
+        # yielded at most i chunks plus the one-ahead stage
+        window.append(pulled["n"] - computed["n"])
+        return fn(dev)
+
+    out = np.empty(n)
+
+    def consume(i, sl, host):
+        out[sl] = host
+        return i
+
+    order = stream(gen(), compute=compute,
+                   put=lambda sl: jax.device_put(jnp.asarray(x[sl])),
+                   consume=consume)
+    assert order == list(range(len(slices)))
+    assert np.array_equal(out, x * 2.0)
+    assert max(window) <= 1        # never more than one chunk ahead
+    # an exhausted-immediately generator is the empty stream
+    assert stream((s for s in []), compute=fn) == []
+
+
 def test_donate_jit_cpu_gating():
     """On CPU the wrapper must NOT request donation (the backend
     ignores it and warns per launch) — the same buffer stays usable

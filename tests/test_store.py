@@ -1,0 +1,347 @@
+"""Out-of-core chip store: round-trip parity, pruning, torn shards.
+
+The store's contract has three legs and each gets direct coverage:
+
+* **bit parity** — writer→reader returns exactly the source values in
+  store order (a pure function of data and grid, not of ingest block
+  boundaries), and the store-fed sharded join matches the in-memory
+  sharded path bit for bit;
+* **pruning is conservative** — fuzzing random query boxes, a
+  bbox-pruned read never loses a row the full scan's filter keeps,
+  and a pruned partition provably stages zero bytes (the join's
+  per-partition ledger reconciles against ``pipeline/h2d_bytes``);
+* **degrade, not die** — torn/truncated shards under the chaos
+  fixtures follow the codec ``on_error`` convention (raise a located
+  CodecError / drop the torn tail / zero-fill), with the
+  ``store/shards_torn`` counter and ``store_shard_torn`` event.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.functions.context import MosaicContext
+from mosaic_tpu.obs import metrics
+from mosaic_tpu.obs.recorder import recorder
+from mosaic_tpu.resilience.ingest import CodecError
+from mosaic_tpu.sql.parser import parse
+from mosaic_tpu.store import (ChipStore, StoreWriter, bbox_from_where,
+                              grid_cells, write_store,
+                              write_store_from_chunks)
+
+RES = 4096
+
+
+def _pts(n, seed=0, lo=(-74.3, 40.5), hi=(-73.7, 40.95)):
+    rng = np.random.default_rng(seed)
+    return np.column_stack([rng.uniform(lo[0], hi[0], n),
+                            rng.uniform(lo[1], hi[1], n)])
+
+
+def _store_order(pts, res=RES):
+    return np.argsort(grid_cells(pts[:, 0], pts[:, 1], res),
+                      kind="stable")
+
+
+# ------------------------------------------------------- round trip
+
+def test_round_trip_bit_parity(tmp_path):
+    pts = _pts(20_000, seed=1)
+    w = np.random.default_rng(2).standard_normal(20_000)
+    tag = np.arange(20_000, dtype=np.int64)
+    man = write_store(str(tmp_path), pts, columns={"w": w, "tag": tag},
+                      grid_res=RES, shard_rows=2048)
+    assert man.total_rows == 20_000
+    assert sum(p.rows for p in man.partitions) == 20_000
+    st = ChipStore(str(tmp_path))
+    cols = st.read_columns()
+    order = _store_order(pts)
+    assert np.array_equal(cols["x"], pts[order, 0])
+    assert np.array_equal(cols["y"], pts[order, 1])
+    assert np.array_equal(cols["w"], w[order])
+    assert np.array_equal(cols["tag"], tag[order])
+    assert cols["tag"].dtype == np.int64      # schema survives
+
+
+def test_multi_block_ingest_matches_one_shot(tmp_path):
+    """Store order is a function of (data, grid) only — block
+    boundaries during ingest are invisible in the read-back."""
+    pts = _pts(9_000, seed=3)
+    one = tmp_path / "one"
+    many = tmp_path / "many"
+    write_store(str(one), pts, grid_res=RES, shard_rows=1024)
+    write_store_from_chunks(
+        str(many), (pts[i:i + 1_000] for i in range(0, 9_000, 1_000)),
+        grid_res=RES, shard_rows=1024)
+    a = ChipStore(str(one)).read_columns()
+    b = ChipStore(str(many)).read_columns()
+    assert np.array_equal(a["x"], b["x"])
+    assert np.array_equal(a["y"], b["y"])
+
+
+def test_iter_chunks_streams_everything_in_store_order(tmp_path):
+    pts = _pts(10_000, seed=4)
+    write_store(str(tmp_path), pts, grid_res=RES, shard_rows=512)
+    st = ChipStore(str(tmp_path))
+    chunks = list(st.iter_chunks(chunk_rows=2048))
+    got = np.concatenate([c.points for c in chunks])
+    order = _store_order(pts)
+    assert np.array_equal(got, pts[order])
+    # full chunks are exactly the pow2 target; spans cover each chunk
+    assert all(c.rows == 2048 for c in chunks[:-1])
+    for c in chunks:
+        assert sum(r for _, r in c.parts) == c.rows
+    # offsets are the running row count
+    assert [c.offset for c in chunks] == \
+        list(np.cumsum([0] + [c.rows for c in chunks[:-1]]))
+
+
+def test_unfinalized_store_is_invisible(tmp_path):
+    """Manifest-last atomicity: a crash before finalize leaves no
+    readable store."""
+    w = StoreWriter(str(tmp_path), grid_res=RES)
+    w.append(_pts(500, seed=5))
+    with pytest.raises(CodecError, match="manifest"):
+        ChipStore(str(tmp_path))
+
+
+# ---------------------------------------------------------- pruning
+
+def test_bbox_pruning_never_drops_a_matching_row_fuzz(tmp_path):
+    pts = _pts(30_000, seed=6)
+    write_store(str(tmp_path), pts, grid_res=RES, shard_rows=4096)
+    st = ChipStore(str(tmp_path))
+    rng = np.random.default_rng(7)
+    pruned_any = False
+    for _ in range(25):
+        x0, x1 = np.sort(rng.uniform(-74.35, -73.65, 2))
+        y0, y1 = np.sort(rng.uniform(40.45, 41.0, 2))
+        bbox = (x0, y0, x1, y1)
+        scanned = st.prune(bbox, record=False)
+        pruned_any |= len(scanned) < len(st.partitions)
+        cols = st.read_columns(bbox=bbox)
+        inside = ((cols["x"] >= x0) & (cols["x"] <= x1) &
+                  (cols["y"] >= y0) & (cols["y"] <= y1))
+        want = ((pts[:, 0] >= x0) & (pts[:, 0] <= x1) &
+                (pts[:, 1] >= y0) & (pts[:, 1] <= y1))
+        # the scanned superset holds EVERY matching row
+        assert int(inside.sum()) == int(want.sum())
+    assert pruned_any                 # the fuzz exercised real pruning
+
+
+def test_prune_counts_metrics(tmp_path):
+    write_store(str(tmp_path), _pts(5_000, seed=8), grid_res=RES)
+    st = ChipStore(str(tmp_path))
+    metrics.enable()
+    p0 = metrics.counter_value("store/partitions_pruned")
+    s0 = metrics.counter_value("store/partitions_scanned")
+    scanned = st.prune((-74.0, 40.6, -73.9, 40.7))
+    assert metrics.counter_value("store/partitions_scanned") - s0 == \
+        len(scanned)
+    assert metrics.counter_value("store/partitions_pruned") - p0 == \
+        len(st.partitions) - len(scanned) > 0
+
+
+def test_bbox_from_where_extraction():
+    def bb(sql):
+        return bbox_from_where(parse(sql).where, "x", "y")
+
+    assert bb("SELECT * FROM t WHERE x >= 1 AND x < 2 "
+              "AND y > 3 AND y <= 4") == (1.0, 3.0, 2.0, 4.0)
+    # literal-first comparisons flip; equality pins both sides
+    assert bb("SELECT * FROM t WHERE 1 <= x AND y = -2") == \
+        (1.0, -2.0, float("inf"), -2.0)
+    # OR at the top level confines nothing (conservative: full scan)
+    assert bb("SELECT * FROM t WHERE x > 1 OR y > 2") is None
+    # non-point columns and column-vs-column comparisons are ignored
+    assert bb("SELECT * FROM t WHERE w > 9") is None
+    assert bb("SELECT * FROM t WHERE x > y") is None
+    assert bb("SELECT * FROM t") is None
+
+
+# ------------------------------------------------- SQL integration
+
+@pytest.fixture(scope="module")
+def mc():
+    return MosaicContext.build("CUSTOM(-180,180,-90,90,2,360,180)")
+
+
+def test_sql_store_scan_parity_and_explain(tmp_path, mc):
+    from mosaic_tpu.sql.engine import SQLSession
+    pts = _pts(8_000, seed=9)
+    w = np.random.default_rng(10).standard_normal(8_000)
+    write_store(str(tmp_path), pts, columns={"w": w}, grid_res=RES,
+                shard_rows=2048)
+    s = SQLSession(mc)
+    s.register_store("chips", str(tmp_path))
+    q = ("FROM chips WHERE x >= -74.0 AND x <= -73.9 "
+         "AND y >= 40.6 AND y <= 40.7")
+    out = s.sql("SELECT x, y, w " + q)
+    # parity vs the same predicate over an in-memory table (row order
+    # differs — store order vs ingest order — so compare as sets)
+    s.create_table("mem", {"x": pts[:, 0], "y": pts[:, 1], "w": w})
+    ref = s.sql("SELECT x, y, w " + q.replace("chips", "mem"))
+    assert len(out) == len(ref) > 0
+    assert np.array_equal(np.sort(np.asarray(out.column("w"))),
+                          np.sort(np.asarray(ref.column("w"))))
+    # EXPLAIN shows pruning as scanned/total without reading data
+    plan = s.sql("EXPLAIN SELECT x " + q)
+    ops = list(plan.column("operator"))
+    parts = plan.column("partitions")[ops.index("scan")]
+    scanned, total = map(int, parts.split("/"))
+    assert 0 < scanned < total
+    # non-store rows show "-"
+    assert plan.column("partitions")[ops.index("filter")] == "-"
+
+
+# ------------------------------------------- store-fed sharded join
+
+def _mesh4():
+    return jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
+
+
+@pytest.fixture(scope="module")
+def workload():
+    from mosaic_tpu.bench.workloads import build_workload
+    from mosaic_tpu.parallel.pip_join import build_pip_index
+    polys, grid, res = build_workload(n_side=6, res_cells=64)
+    idx = build_pip_index(polys, res, grid)
+    return polys, grid, res, idx
+
+
+def test_store_fed_join_bit_parity_vs_in_memory(tmp_path, workload):
+    from mosaic_tpu.parallel.pip_join import (
+        make_sharded_streamed_pip_join, make_store_sharded_pip_join)
+    polys, grid, res, idx = workload
+    pts = _pts(20_000, seed=11)
+    write_store(str(tmp_path), pts, grid_res=RES, shard_rows=2048)
+    st = ChipStore(str(tmp_path))
+    mesh = _mesh4()
+    sj = make_store_sharded_pip_join(st, idx, grid, mesh, polys=polys,
+                                     chunk=4096, refresh=2)
+    zone_s, rc_s = sj()
+    cols = st.read_columns(cols=st.point_cols)
+    store_pts = np.column_stack([cols["x"], cols["y"]])
+    mj = make_sharded_streamed_pip_join(idx, grid, mesh, polys=polys,
+                                        chunk=4096, refresh=2)
+    zone_m, rc_m = mj(store_pts)
+    assert np.array_equal(zone_s, zone_m)
+    assert rc_s == rc_m
+    # the placement pass observed every chunk
+    assert sj.rebalancer.observations == len(zone_s) // 4096 + \
+        (1 if len(zone_s) % 4096 else 0)
+
+
+def test_store_fed_join_pruned_partitions_never_staged(tmp_path,
+                                                       workload):
+    """The acceptance invariant: a bbox query stages ZERO bytes for
+    pruned partitions.  The join's per-partition ledger covers only
+    scanned cells AND reconciles byte-for-byte with the pipeline's
+    ``pipeline/h2d_bytes`` staging counter, so no staged byte can hide
+    under a pruned cell; the memwatch ledger drains to zero live
+    bytes (nothing stayed resident)."""
+    from mosaic_tpu.obs.memwatch import memwatch
+    from mosaic_tpu.parallel.pip_join import make_store_sharded_pip_join
+    polys, grid, res, idx = workload
+    pts = _pts(20_000, seed=12)
+    write_store(str(tmp_path), pts, grid_res=RES, shard_rows=2048)
+    st = ChipStore(str(tmp_path))
+    bbox = (-74.05, 40.6, -73.9, 40.75)
+    scanned = {p.cell for p in st.prune(bbox, record=False)}
+    pruned = {p.cell for p in st.partitions} - scanned
+    assert scanned and pruned          # non-vacuous on both sides
+    metrics.enable()
+    sj = make_store_sharded_pip_join(st, idx, grid, _mesh4(),
+                                     polys=polys, chunk=2048)
+    h2d0 = metrics.counter_value("pipeline/h2d_bytes")
+    zone, _ = sj(bbox=bbox)
+    h2d = metrics.counter_value("pipeline/h2d_bytes") - h2d0
+    ledger = sj.staged_bytes_by_partition
+    assert set(ledger) <= scanned
+    assert not (set(ledger) & pruned)
+    assert sum(ledger.values()) == int(h2d) > 0
+    assert len(zone) == sum(p.rows for p in st.prune(bbox,
+                                                     record=False))
+    if memwatch.enabled:
+        assert memwatch.live_bytes() == 0
+
+
+# --------------------------------------------------- chaos / faults
+
+def test_torn_shard_skip_drops_only_torn_tail(tmp_path, fault_plan):
+    pts = _pts(4_000, seed=13)
+    write_store(str(tmp_path), pts, grid_res=64, shard_rows=512)
+    clean = ChipStore(str(tmp_path), on_error="raise")
+    full = clean.read_columns()
+    metrics.enable()
+    recorder.enable()
+    t0 = metrics.counter_value("store/shards_torn")
+    fault_plan("seed=21;site=store.shard,fails=1,mode=truncate")
+    st = ChipStore(str(tmp_path), on_error="skip")
+    cols = st.read_columns()
+    lost = len(full["x"]) - len(cols["x"])
+    assert 0 < lost < len(full["x"])   # torn tail dropped, rest intact
+    assert metrics.counter_value("store/shards_torn") - t0 >= 1
+    evs = recorder.events("store_shard_torn")
+    assert evs and evs[-1]["mode"] == "skip"
+    # surviving values are a sub-multiset of the clean read
+    vals, counts = np.unique(cols["x"], return_counts=True)
+    fvals, fcounts = np.unique(full["x"], return_counts=True)
+    idx_in_full = np.searchsorted(fvals, vals)
+    assert np.array_equal(fvals[idx_in_full], vals)
+    assert np.all(counts <= fcounts[idx_in_full])
+
+
+def test_torn_shard_raise_and_null_modes(tmp_path, fault_plan):
+    pts = _pts(2_000, seed=14)
+    write_store(str(tmp_path), pts, grid_res=64, shard_rows=256)
+    clean = ChipStore(str(tmp_path), on_error="raise")
+    n_full = len(clean.read_columns()["x"])
+    fault_plan("seed=22;site=store.shard,fails=1,mode=truncate")
+    with pytest.raises(CodecError, match="torn shard"):
+        ChipStore(str(tmp_path), on_error="raise").read_columns()
+    fault_plan("seed=22;site=store.shard,fails=1,mode=truncate")
+    cols = ChipStore(str(tmp_path), on_error="null").read_columns()
+    # null mode keeps the row count, zero-filling the torn tail
+    assert len(cols["x"]) == n_full
+
+
+def test_store_read_fault_surfaces(tmp_path, fault_plan):
+    write_store(str(tmp_path), _pts(500, seed=15), grid_res=64)
+    from mosaic_tpu.resilience.faults import InjectedFault
+    fault_plan("seed=23;site=store.read,fails=1")
+    with pytest.raises(InjectedFault):
+        ChipStore(str(tmp_path))
+
+
+def test_store_write_fault_leaves_no_store(tmp_path, fault_plan):
+    """An injected crash during ingest must leave the target
+    unreadable (manifest-last atomicity), not half-written."""
+    from mosaic_tpu.resilience.faults import InjectedFault
+    fault_plan("seed=24;site=store.write,fails=1")
+    w = StoreWriter(str(tmp_path), grid_res=64)
+    with pytest.raises(InjectedFault):
+        w.append(_pts(500, seed=16))
+    with pytest.raises(CodecError, match="manifest"):
+        ChipStore(str(tmp_path))
+
+
+# ----------------------------------------------------------- config
+
+def test_store_conf_keys_registered():
+    cfg = _config.MosaicConfig()
+    cfg = _config.apply_conf(cfg, "mosaic.store.dir", "/tmp/s")
+    cfg = _config.apply_conf(cfg, "mosaic.store.grid.res", "2048")
+    cfg = _config.apply_conf(cfg, "mosaic.store.shard.rows", "65536")
+    cfg = _config.apply_conf(cfg, "mosaic.store.mmap", "false")
+    assert cfg.store_dir == "/tmp/s"
+    assert cfg.store_grid_res == 2048
+    assert cfg.store_shard_rows == 65536
+    assert cfg.store_mmap is False
+    with pytest.raises(_config.ConfigError):
+        _config.apply_conf(cfg, "mosaic.store.grid.res", "0")
+    with pytest.raises(_config.ConfigError):
+        _config.apply_conf(cfg, "mosaic.store.mmap", "maybe")

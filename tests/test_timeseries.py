@@ -301,3 +301,31 @@ def test_watchdog_metric_lists_match_bench_guard():
             found[node.targets[0].id] = ast.literal_eval(node.value)
     assert found["lower_better"] == bench_watchdog.LOWER_BETTER
     assert found["higher_better"] == bench_watchdog.HIGHER_BETTER
+
+
+def test_watchdog_store_metrics_guard_after_two_rounds():
+    """``store.*`` metrics trend from their first record but only
+    join the 20% guard once TWO history rounds carry the key — the
+    first round of a new bench stage must not hard-fail the guard,
+    and the gated keys stay out of the pinned bench lists."""
+    assert not (set(bench_watchdog.GUARD_AFTER_HISTORY)
+                & set(bench_watchdog.LOWER_BETTER
+                      + bench_watchdog.HIGHER_BETTER
+                      + bench_watchdog.TREND_ONLY))
+    bad = {"store": {"ingest_s": 20.0, "query_pts_per_s": 100.0}}
+    ok = {"store": {"ingest_s": 10.0, "query_pts_per_s": 1000.0}}
+    one = [("1", ok)]
+    r = bench_watchdog.analyze(one, bad)
+    assert r["regressions"] == []            # 1 round: trend only
+    assert r["trends"]["store.ingest_s"]["direction"] == "trend"
+    assert r["trends"]["store.ingest_s"]["history"] == [10.0]
+    two = [("1", ok), ("2", ok)]
+    r = bench_watchdog.analyze(two, bad)     # 2 rounds: guard armed
+    assert any(m.startswith("store.ingest_s")
+               for m in r["regressions"])
+    assert any(m.startswith("store.query_pts_per_s")
+               for m in r["regressions"])
+    assert r["trends"]["store.ingest_s"]["direction"] == \
+        "lower_better"
+    clean = bench_watchdog.analyze(two, ok)
+    assert clean["regressions"] == []

@@ -39,6 +39,7 @@ import statistics
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["LOWER_BETTER", "HIGHER_BETTER", "TREND_ONLY",
+           "GUARD_AFTER_HISTORY",
            "load_history", "analyze", "to_markdown", "main"]
 
 # Local copies of bench.perf_guard's metric direction lists (kept in
@@ -61,6 +62,15 @@ HIGHER_BETTER = ["value", "knn_rows_per_sec", "sharded_pts_per_sec"]
 TREND_ONLY = ["memory.flagship_peak_bytes",
               "memory.flagship_peak_bytes_per_row"]
 
+# Out-of-core store metrics (the bench's "store" block, first recorded
+# in BENCH_r07): trended from their first appearance, but they join
+# the 20% regression guard only once at least TWO history rounds carry
+# the key — a brand-new stage's single round is not a baseline, and
+# guarding against it would turn ordinary round-to-round noise into a
+# hard failure.  Values are the guard direction once armed.
+GUARD_AFTER_HISTORY = {"store.ingest_s": "lower",
+                       "store.query_pts_per_s": "higher"}
+
 
 def _num(rec: dict, key: str) -> Optional[float]:
     v: object = rec
@@ -74,11 +84,18 @@ def _num(rec: dict, key: str) -> Optional[float]:
 def _unwrap(rec: dict) -> Optional[dict]:
     """A BENCH file is either the bench record itself or a runner
     wrapper ``{"n", "cmd", "rc", "tail"}`` whose ``tail`` captures the
-    bench stdout — the record is then the last JSON line inside it."""
+    bench stdout — the record is then the last JSON line inside it.
+    Wrappers may also carry the record pre-parsed under ``parsed``,
+    which survives even when the captured tail was truncated mid-line
+    (a truncated tail used to silently drop the round from history)."""
     if not isinstance(rec, dict):
         return None
     if "metric" in rec or "platform" in rec:
         return rec
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict) and ("metric" in parsed
+                                     or "platform" in parsed):
+        return parsed
     tail = rec.get("tail")
     if not isinstance(tail, str):
         return None
@@ -146,12 +163,17 @@ def analyze(history: List[Tuple[str, dict]], current: dict,
     regressions: List[str] = []
     spikes: List[str] = []
     trends: Dict[str, dict] = {}
-    for key in LOWER_BETTER + HIGHER_BETTER + TREND_ONLY:
-        lower = key in LOWER_BETTER
-        trend_only = key in TREND_ONLY
+    for key in (LOWER_BETTER + HIGHER_BETTER + TREND_ONLY
+                + sorted(GUARD_AFTER_HISTORY)):
+        lower = key in LOWER_BETTER or \
+            GUARD_AFTER_HISTORY.get(key) == "lower"
         cur = _num(current, key)
         traj = [v for v in (_num(r, key) for _, r in hist)
                 if v is not None]
+        # history-gated keys stay trend-only until the trajectory
+        # itself (current excluded) holds two rounds to baseline on
+        trend_only = key in TREND_ONLY or (
+            key in GUARD_AFTER_HISTORY and len(traj) < 2)
         if cur is None and not traj:
             continue
         trends[key] = {
