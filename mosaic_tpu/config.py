@@ -137,6 +137,23 @@ MOSAIC_SERVE_DRAIN_MS = "mosaic.serve.drain.ms"
 MOSAIC_SERVE_BATCH_WINDOW_MS = "mosaic.serve.batch.window.ms"
 MOSAIC_SERVE_BATCH_MAX = "mosaic.serve.batch.max"
 MOSAIC_SERVE_BATCH_ROWS_MAX = "mosaic.serve.batch.rows.max"
+# Supervised serving fleet (serve/supervisor.py + serve/scoreboard.py):
+# worker-process count, the fleet runtime directory (ready files,
+# scoreboard, supervisor.json; "" = a fresh temp dir per fleet), the
+# crash-loop circuit breaker (more than `restart.max` respawns inside
+# `restart.window.ms` parks the slot and the fleet runs degraded at
+# N-1), the supervisor health-check cadence (0 disables the watchdog
+# thread), how often dead workers' scoreboard claims are reaped (the
+# under-admission bound), and the shared admission scoreboard's slot
+# count (bounds fleet-wide queued+running + rate-window claims).
+MOSAIC_SERVE_FLEET_WORKERS = "mosaic.serve.fleet.workers"
+MOSAIC_SERVE_FLEET_DIR = "mosaic.serve.fleet.dir"
+MOSAIC_SERVE_FLEET_RESTART_MAX = "mosaic.serve.fleet.restart.max"
+MOSAIC_SERVE_FLEET_RESTART_WINDOW_MS = \
+    "mosaic.serve.fleet.restart.window.ms"
+MOSAIC_SERVE_FLEET_HEALTH_MS = "mosaic.serve.fleet.health.ms"
+MOSAIC_SERVE_FLEET_REAP_MS = "mosaic.serve.fleet.reap.ms"
+MOSAIC_SERVE_SCOREBOARD_SLOTS = "mosaic.serve.scoreboard.slots"
 # Fleet telemetry plane (obs/spool.py + obs/fleet.py): the directory
 # per-process telemetry spools are written to ("" disables spooling;
 # writes ride the Sampler tick, so mosaic.obs.sample.ms must also be
@@ -282,6 +299,15 @@ class MosaicConfig:
     serve_batch_window_ms: float = 2.0
     serve_batch_max: int = 32
     serve_batch_rows_max: int = 4_096
+    # Supervised serving fleet — see the mosaic.serve.fleet.* key
+    # comments above.
+    serve_fleet_workers: int = 2
+    serve_fleet_dir: str = ""
+    serve_fleet_restart_max: int = 5
+    serve_fleet_restart_window_ms: float = 30_000.0
+    serve_fleet_health_ms: float = 250.0
+    serve_fleet_reap_ms: float = 1_000.0
+    serve_scoreboard_slots: int = 512
     # Fleet telemetry plane — see the mosaic.obs.fleet.* key comments
     # above.  "" = no spooling.
     obs_fleet_dir: str = ""
@@ -481,6 +507,17 @@ _CONF_FIELDS = {
     MOSAIC_SERVE_BATCH_MAX: ("serve_batch_max", _as_count),
     MOSAIC_SERVE_BATCH_ROWS_MAX: ("serve_batch_rows_max",
                                   _as_blocksize),
+    MOSAIC_SERVE_FLEET_WORKERS: ("serve_fleet_workers", _as_blocksize),
+    MOSAIC_SERVE_FLEET_DIR: ("serve_fleet_dir", _as_str),
+    MOSAIC_SERVE_FLEET_RESTART_MAX: ("serve_fleet_restart_max",
+                                     _as_blocksize),
+    MOSAIC_SERVE_FLEET_RESTART_WINDOW_MS:
+        ("serve_fleet_restart_window_ms", _as_millis),
+    MOSAIC_SERVE_FLEET_HEALTH_MS: ("serve_fleet_health_ms",
+                                   _as_millis),
+    MOSAIC_SERVE_FLEET_REAP_MS: ("serve_fleet_reap_ms", _as_millis),
+    MOSAIC_SERVE_SCOREBOARD_SLOTS: ("serve_scoreboard_slots",
+                                    _as_blocksize),
     MOSAIC_OBS_FLEET_DIR: ("obs_fleet_dir", _as_str),
     MOSAIC_OBS_FLEET_STALE_MS: ("obs_fleet_stale_ms", _as_millis),
     MOSAIC_OBS_FLEET_WINDOW_MS: ("obs_fleet_window_ms", _as_millis),

@@ -221,6 +221,7 @@ def _fleet_payload(qs: Dict[str, list]) -> Dict[str, object]:
         traces = agg.stitched_traces(view)
         return {"enabled": True,
                 "fleet": view.payload(),
+                "supervisor": _supervisor_status(directory),
                 "slo_fleet": agg.evaluate_slo(view),
                 "traces": {tid: {"workers": t["workers"],
                                  "spans": len(t["spans"])}
@@ -228,6 +229,22 @@ def _fleet_payload(qs: Dict[str, list]) -> Dict[str, object]:
     except Exception as exc:      # a broken spool dir must not 500
         return {"enabled": True, "dir": directory,
                 "error": f"{type(exc).__name__}: {exc}"}
+
+
+def _supervisor_status(directory: str):
+    """The serving-fleet supervisor's status file, when the spool dir
+    doubles as a ServeFleet runtime dir (serve/supervisor.py writes
+    ``supervisor.json`` atomically each health tick).  None when no
+    supervisor runs over this directory; a torn/absent file is a
+    degrade, never a panel error."""
+    import json as _json
+    import os as _os
+    path = _os.path.join(directory, "supervisor.json")
+    try:
+        with open(path) as f:
+            return _json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def _profile_payload(qs: Dict[str, list]) -> Dict[str, object]:

@@ -71,6 +71,9 @@ EVENTS = frozenset({
     # fleet telemetry plane (spool/fleet): cross-process trace links
     # and aggregator degrade paths
     "trace_link", "fleet_worker_stale", "fleet_merge_error",
+    # serving fleet supervisor (serve/supervisor.py): worker process
+    # lifecycle + the crash-loop circuit breaker
+    "fleet_worker_spawn", "fleet_worker_exit", "fleet_degraded",
     # SLO + profiler
     "slo_breach", "slo_recovered", "profiler",
     # pipeline observer hook failures

@@ -48,7 +48,8 @@ from .timeseries import TimeSeriesStore, timeseries
 
 __all__ = ["SLObjective", "SLOMonitor", "monitor",
            "default_objectives", "principal_objectives",
-           "serve_objectives", "evaluate_fleet", "KINDS"]
+           "serve_objectives", "fleet_objectives", "evaluate_fleet",
+           "KINDS"]
 
 KINDS = ("latency", "error_rate", "counter_rate", "gauge_max")
 
@@ -192,6 +193,21 @@ def serve_objectives(queue_depth: int,
                     kind="gauge_max",
                     series="serve/queue_depth",
                     ceiling=max(1.0, 0.9 * float(queue_depth))),
+    ]
+
+
+def fleet_objectives() -> List[SLObjective]:
+    """The objective :class:`~..serve.supervisor.ServeFleet` registers
+    in the supervisor process: any worker slot parked by the
+    crash-loop circuit breaker (the ``fleet/degraded_workers`` series
+    the health tick records) is a breach — the fleet is serving, but
+    at N-1, and an operator should know before the next worker
+    follows.  Ceiling 0.5 so the first degraded slot (gauge 1.0)
+    crosses; a clean respawn never records a nonzero point."""
+    return [
+        SLObjective(name="fleet_degraded", kind="gauge_max",
+                    series="fleet/degraded_workers", ceiling=0.5,
+                    windows=(30.0, 60.0)),
     ]
 
 

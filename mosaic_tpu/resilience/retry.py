@@ -33,7 +33,9 @@ from . import faults
 
 __all__ = ["RetryPolicy", "retrying", "ProbeFailure",
            "CHECKPOINT_RETRY", "NATIVE_COMPILE_RETRY",
-           "NATIVE_LOAD_RETRY", "BENCH_PROBE_RETRY"]
+           "NATIVE_LOAD_RETRY", "BENCH_PROBE_RETRY",
+           "SERVE_SPAWN_RETRY", "FLEET_RESPAWN_BACKOFF",
+           "LOADTEST_CONNECT_RETRY"]
 
 
 class ProbeFailure(RuntimeError):
@@ -149,3 +151,26 @@ BENCH_PROBE_RETRY = RetryPolicy(name="bench.probe", max_attempts=3,
                                 multiplier=2.0,
                                 retry_on=(ProbeFailure, OSError,
                                           _subprocess.SubprocessError))
+
+#: fleet worker exec (serve/supervisor.py): transient fork/exec
+#: failures (the ``serve.spawn`` fault site among them) retry fast; a
+#: missing interpreter fails fast three times and the health loop's
+#: breaker takes over
+SERVE_SPAWN_RETRY = RetryPolicy(
+    name="serve.spawn", max_attempts=3, base_delay_s=0.05,
+    max_delay_s=0.5, retry_on=(OSError,
+                               _subprocess.SubprocessError))
+
+#: crash-respawn schedule (not a call-retry: the supervisor only uses
+#: ``delay(k)`` for the k-th respawn inside the breaker window, so a
+#: crash-looping worker backs off exponentially instead of spinning)
+FLEET_RESPAWN_BACKOFF = RetryPolicy(
+    name="fleet.respawn", max_attempts=1_000_000,
+    base_delay_s=0.1, max_delay_s=2.0, multiplier=2.0)
+
+#: loadtest client connects (tools/loadtest.py): jittered backoff over
+#: refused/reset connects, so the kill drill's clients ride through a
+#: worker SIGKILL window instead of booking instant errors
+LOADTEST_CONNECT_RETRY = RetryPolicy(
+    name="loadtest.connect", max_attempts=4, base_delay_s=0.05,
+    max_delay_s=0.5, retry_on=(ConnectionError, OSError))

@@ -16,12 +16,16 @@ Tuned by the ``mosaic.serve.*`` conf keys (docs/usage/serving.md).
 
 from .admission import AdmissionQueue, Deny, ServeRequest
 from .batching import KERNEL_NAME, execute_batch
+from .scoreboard import Scoreboard, ScoreboardError, SlotToken
 from .server import QueryServer, current_server, install_sigterm_drain
+from .supervisor import ServeFleet, WorkerSlot, worker_main
 from .workers import WorkerPool
 
 __all__ = [
     "AdmissionQueue", "Deny", "ServeRequest",
     "KERNEL_NAME", "execute_batch",
+    "Scoreboard", "ScoreboardError", "SlotToken",
     "QueryServer", "current_server", "install_sigterm_drain",
+    "ServeFleet", "WorkerSlot", "worker_main",
     "WorkerPool",
 ]

@@ -20,6 +20,13 @@ Workers drain the queue highest-priority-first (FIFO within a
 priority) via :meth:`take`; :meth:`take_compatible` additionally pulls
 queued point lookups that share a batch signature so one device
 launch can serve several queries (serve/batching.py).
+
+Fleet mode (serve/supervisor.py): constructed with a shared
+:class:`~.scoreboard.Scoreboard`, the rate and concurrency checks
+become one atomic count-and-claim against the mmap'd scoreboard, so
+the same quotas hold across every worker process; the claim token
+rides on the request and is released on completion, shed, or flush —
+and by the supervisor's reaper if this whole process dies holding it.
 """
 
 from __future__ import annotations
@@ -66,6 +73,9 @@ class ServeRequest:
                  deadline_ms: float = 0.0, lookup=None,
                  traceparent: Optional[str] = None):
         import concurrent.futures
+        #: fleet mode: the scoreboard CONC claim this request holds
+        #: from admission until release/shed/flush (SlotToken)
+        self.sb_token = None
         self.sql = sql
         self.label = " ".join(sql.split())[:60]
         self.principal = principal
@@ -134,10 +144,15 @@ class AdmissionQueue:
     (callers: the asyncio loop thread offers, worker threads take)."""
 
     def __init__(self, depth: int, quota_concurrency: int,
-                 quota_qps: float):
+                 quota_qps: float, scoreboard=None):
         self.depth = int(depth)
         self.quota_concurrency = int(quota_concurrency)
         self.quota_qps = float(quota_qps)
+        #: fleet mode (serve/scoreboard.py): when set, the rate and
+        #: concurrency quotas are enforced against the shared mmap
+        #: scoreboard (atomic count-and-claim across every worker
+        #: process) instead of this queue's process-local state
+        self.scoreboard = scoreboard
         self._cond = threading.Condition()
         self._queued: List[ServeRequest] = []
         self._running: Dict[str, int] = collections.defaultdict(int)
@@ -157,35 +172,43 @@ class AdmissionQueue:
         with self._cond:
             if self.draining:
                 return self._deny(req, Deny(503, "draining", 1.0))
-            win = self._rate[req.principal]
-            while win and now - win[0] > _RATE_WINDOW_S:
-                win.popleft()
-            if self.quota_qps > 0 and len(win) >= self.quota_qps:
-                return self._deny(req, Deny(
-                    429, "rate_quota",
-                    win[0] + _RATE_WINDOW_S - now))
-            if self.quota_concurrency > 0:
-                held = self._running[req.principal] + \
-                    sum(1 for r in self._queued
-                        if r.principal == req.principal)
-                if held >= self.quota_concurrency:
+            if self.scoreboard is not None:
+                deny = self._offer_scoreboard_locked(req)
+                if deny is not None:
+                    return deny
+            else:
+                win = self._rate[req.principal]
+                while win and now - win[0] > _RATE_WINDOW_S:
+                    win.popleft()
+                if self.quota_qps > 0 and len(win) >= self.quota_qps:
                     return self._deny(req, Deny(
-                        429, "concurrency_quota",
-                        self._latency_hint(req.principal)))
+                        429, "rate_quota",
+                        win[0] + _RATE_WINDOW_S - now))
+                if self.quota_concurrency > 0:
+                    held = self._running[req.principal] + \
+                        sum(1 for r in self._queued
+                            if r.principal == req.principal)
+                    if held >= self.quota_concurrency:
+                        return self._deny(req, Deny(
+                            429, "concurrency_quota",
+                            self._latency_hint(req.principal)))
             if est_bytes > 0:
                 from ..obs.memwatch import mem_budget
                 if not mem_budget.admit(est_bytes):
+                    self._release_token(req)
                     return self._deny(req, Deny(429, "memory_budget",
                                                 1.0))
             if len(self._queued) >= self.depth:
                 victim = min(self._queued,
                              key=lambda r: (r.priority, -r.seq))
                 if victim.priority >= req.priority:
+                    self._release_token(req)
                     return self._shed_one(req, evicted=False)
                 self._queued.remove(victim)
                 self._shed_one(victim, evicted=True)
             self._queued.append(req)
-            win.append(now)
+            if self.scoreboard is None:
+                self._rate[req.principal].append(now)
             self._admitted[req.principal] += 1
             self._cond.notify()
             if metrics.enabled:
@@ -193,6 +216,30 @@ class AdmissionQueue:
                 metrics.gauge("serve/queue_depth",
                               float(len(self._queued)))
         return None
+
+    def _offer_scoreboard_locked(self,
+                                 req: ServeRequest) -> Optional[Deny]:
+        """Fleet-wide admission: one atomic count-and-claim against
+        the shared scoreboard.  On success the request carries the
+        CONC token until release/shed/flush; a worker dying with it
+        leaks nothing — the supervisor's reap (or the next admission
+        for the tenant) frees dead-owner slots."""
+        token, refused = self.scoreboard.admit(
+            req.principal, self.quota_concurrency, self.quota_qps)
+        if refused is not None:
+            reason, retry_after = refused
+            if reason == "concurrency_quota":
+                retry_after = self._latency_hint(req.principal)
+            status = 503 if reason == "scoreboard_full" else 429
+            return self._deny(req, Deny(status, reason, retry_after))
+        req.sb_token = token
+        return None
+
+    def _release_token(self, req: ServeRequest) -> None:
+        """Give a held scoreboard claim back (idempotent)."""
+        token, req.sb_token = req.sb_token, None
+        if token is not None and self.scoreboard is not None:
+            self.scoreboard.release(token)
 
     def _deny(self, req: ServeRequest, deny: Deny) -> Deny:
         if metrics.enabled:
@@ -202,7 +249,9 @@ class AdmissionQueue:
 
     def _shed_one(self, req: ServeRequest, evicted: bool) -> Deny:
         """Overload shed: count it, flight-record it, and — for an
-        evicted queued request — resolve its future with the 429."""
+        evicted queued request — resolve its future with the 429.
+        Either way the victim's scoreboard claim goes back."""
+        self._release_token(req)
         self._shed[req.principal] += 1
         deny = Deny(429, "shed", 1.0)
         if metrics.enabled:
@@ -273,6 +322,7 @@ class AdmissionQueue:
         with self._cond:
             self._running[req.principal] = \
                 max(0, self._running[req.principal] - 1)
+            self._release_token(req)
 
     # -- drain + reads -------------------------------------------------
     def start_drain(self) -> None:
@@ -293,6 +343,7 @@ class AdmissionQueue:
         with self._cond:
             pending, self._queued = self._queued, []
         for r in pending:
+            self._release_token(r)
             r.resolve(status, {"error": "denied", "reason": reason,
                                "retry_after_s": 1.0}, reason)
         return len(pending)

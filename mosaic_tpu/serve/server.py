@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import signal
 import threading
 import time
@@ -93,16 +94,28 @@ class QueryServer:
     def __init__(self, session: SQLSession,
                  host: str = "127.0.0.1",
                  port: Optional[int] = None,
-                 workers: Optional[int] = None):
+                 workers: Optional[int] = None,
+                 sock=None,
+                 reuse_port: bool = False,
+                 scoreboard=None):
         cfg = _config.default_config()
         self.session = session
         self.host = host
         self._want_port = cfg.serve_port if port is None else int(port)
         self.port: int = 0
+        #: fleet mode (serve/supervisor.py): either an already-bound
+        #: listening socket inherited from the supervisor, or
+        #: SO_REUSEPORT so N worker processes share one (host, port)
+        self._sock = sock
+        self._reuse_port = bool(reuse_port)
+        #: shared mmap Scoreboard — when set, per-tenant rate +
+        #: concurrency quotas are enforced fleet-wide
+        self.scoreboard = scoreboard
         self.queue = AdmissionQueue(
             depth=cfg.serve_queue_depth,
             quota_concurrency=cfg.serve_quota_concurrency,
-            quota_qps=cfg.serve_quota_qps)
+            quota_qps=cfg.serve_quota_qps,
+            scoreboard=scoreboard)
         self.pool = WorkerPool(
             session, self.queue,
             workers=cfg.serve_workers if workers is None else workers,
@@ -212,6 +225,12 @@ class QueryServer:
         self._sigterm_prev = signal.signal(signal.SIGTERM,
                                            self._on_sigterm)
 
+    def wait_stopped(self, timeout: Optional[float] = None) -> bool:
+        """Block until the serve loop exited (drain finished or plain
+        stop); fleet workers park their main thread here.  True when
+        it stopped within ``timeout``."""
+        return self._stopped.wait(timeout)
+
     # -- asyncio side --------------------------------------------------
     def _loop_main(self) -> None:
         loop = asyncio.new_event_loop()
@@ -228,8 +247,16 @@ class QueryServer:
             self._stopped.set()
 
     async def _serve_forever(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self._want_port)
+        if self._sock is not None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, sock=self._sock)
+        elif self._reuse_port:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self._want_port,
+                reuse_port=True)
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self._want_port)
         self.port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
         try:
@@ -322,6 +349,7 @@ class QueryServer:
         if method == "GET" and target == "/healthz":
             await self._respond_json(writer, 200, {
                 "status": "draining" if self.draining else "ok",
+                "pid": os.getpid(),
                 "queued": self.queue.queued_count(),
                 "running": self.queue.running_count(),
                 "workers": self.pool.workers}, keep=keep)
@@ -570,8 +598,9 @@ class QueryServer:
             v = metrics.counter_value(name)
             if v:
                 counters[name.split("/", 1)[1]] = int(v)
-        return {
+        out = {
             "running": True,
+            "pid": os.getpid(),
             "addr": f"{self.host}:{self.port}",
             "draining": self.draining,
             "uptime_s": round(time.time() - self.t_start, 1)
@@ -584,11 +613,26 @@ class QueryServer:
             "queue": q,
             "quotas": {"concurrency": self.queue.quota_concurrency,
                        "qps": self.queue.quota_qps,
-                       "queue_depth": self.queue.depth},
+                       "queue_depth": self.queue.depth,
+                       "scope": "fleet" if self.scoreboard is not None
+                       else "process"},
             "batching": {"max": self.pool.batch_max,
                          "window_ms": self.pool.batch_window_ms},
+            # warm-fleet proof: a respawned worker over a shared
+            # persistent XLA cache must show persistent_misses == 0
+            "jit": {"persistent_hits": int(
+                        metrics.counter_value("jax/cache/cache_hits")),
+                    "persistent_misses": int(
+                        metrics.counter_value(
+                            "jax/cache/cache_misses"))},
             "counters": counters,
         }
+        if self.scoreboard is not None:
+            try:
+                out["scoreboard"] = self.scoreboard.snapshot()
+            except (OSError, ValueError):
+                out["scoreboard"] = None
+        return out
 
 
 def install_sigterm_drain(server: QueryServer) -> None:

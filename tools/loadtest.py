@@ -17,6 +17,16 @@ are computed by ``obs.metrics``, not by this script.  Outcomes are
 bucketed by HTTP status: ok (200), denied (429 admission), shed
 (429 with reason=shed), deadline (504), cancelled (499), error.
 
+Fleet mode (``--fleet`` / ``failover=True``): connects retry with
+jittered backoff through ``resilience.retry.LOADTEST_CONNECT_RETRY``
+(bounded — a down fleet still fails), and a request that dies with a
+torn connection mid-flight is retried ONCE on a fresh connection —
+against a ``ServeFleet`` the kernel routes the retry to a surviving
+worker, so a SIGKILLed worker costs latency, not answers.  The
+summary reports ``connect_retries`` and ``failovers`` separately from
+the ``lost`` outcome bucket (dead even after the retry), so a kill
+drill distinguishes lost-forever from retried-ok.
+
 :func:`deadline_curve` sweeps offered QPS (open-loop pacing) under a
 fixed per-request deadline and reports the deadline-miss fraction at
 each level — the knee of that curve is the server's sustainable
@@ -43,36 +53,93 @@ def _w3c_traceparent(rng) -> str:
     return f"00-{trace:032x}-{span:016x}-01"
 
 
+class ClientCounters:
+    """Thread-safe tally shared by every client thread: connect
+    retries, mid-flight failovers — the kill drill's evidence that
+    requests were retried-ok rather than lost."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, int] = {}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._data[key] = self._data.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._data.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._data)
+
+
+def _connect(host: str, port: int, timeout: float,
+             counters: Optional[ClientCounters]):
+    """A connected HTTPConnection, retrying refused/reset connects
+    with the bounded jittered-backoff policy (a fleet worker dying
+    between accept queues surfaces here)."""
+    import http.client
+    from mosaic_tpu.resilience.retry import LOADTEST_CONNECT_RETRY
+
+    def attempt():
+        c = http.client.HTTPConnection(host, port, timeout=timeout)
+        c.connect()
+        return c
+
+    def on_retry(exc, n):
+        if counters is not None:
+            counters.bump("connect_retries")
+
+    return LOADTEST_CONNECT_RETRY.call(attempt, on_retry=on_retry)
+
+
 def _post_query(host: str, port: int, sql: str, principal: str,
                 priority: int = 0, deadline_ms: float = 0.0,
                 timeout: float = 30.0,
-                traceparent: Optional[str] = None) -> Tuple[int, str]:
+                traceparent: Optional[str] = None,
+                counters: Optional[ClientCounters] = None,
+                failover: bool = False) -> Tuple[int, str]:
     """One POST /query on a fresh connection; returns (status,
-    reason) where reason is the deny reason for 429s, "" otherwise."""
-    import http.client
-    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    reason) where reason is the deny reason for 429s, "" otherwise.
+    ``failover=True`` retries a torn-connection request exactly once
+    on a fresh connection (queries are read-only — safe to replay);
+    the second failure propagates to the caller as lost."""
+
+    def attempt() -> Tuple[int, str]:
+        conn = _connect(host, port, timeout, counters)
+        try:
+            headers = {"X-Mosaic-Principal": principal,
+                       "Content-Type": "text/plain"}
+            if priority:
+                headers["X-Mosaic-Priority"] = str(priority)
+            if deadline_ms > 0:
+                headers["X-Mosaic-Deadline-Ms"] = str(deadline_ms)
+            if traceparent:
+                headers["traceparent"] = traceparent
+            conn.request("POST", "/query", body=sql.encode(),
+                         headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            reason = ""
+            if resp.status in (429, 503):
+                try:
+                    reason = json.loads(body).get("reason", "")
+                except Exception:
+                    pass
+            return resp.status, reason
+        finally:
+            conn.close()
+
     try:
-        headers = {"X-Mosaic-Principal": principal,
-                   "Content-Type": "text/plain"}
-        if priority:
-            headers["X-Mosaic-Priority"] = str(priority)
-        if deadline_ms > 0:
-            headers["X-Mosaic-Deadline-Ms"] = str(deadline_ms)
-        if traceparent:
-            headers["traceparent"] = traceparent
-        conn.request("POST", "/query", body=sql.encode(),
-                     headers=headers)
-        resp = conn.getresponse()
-        body = resp.read()
-        reason = ""
-        if resp.status in (429, 503):
-            try:
-                reason = json.loads(body).get("reason", "")
-            except Exception:
-                pass
-        return resp.status, reason
-    finally:
-        conn.close()
+        return attempt()
+    except Exception:
+        if not failover:
+            raise
+        if counters is not None:
+            counters.bump("failovers")
+        return attempt()
 
 
 def _bucket(status: int, reason: str) -> str:
@@ -95,13 +162,15 @@ def run_loadtest(host: str, port: int,
                  duration_s: float = 3.0,
                  principals: Optional[Sequence[str]] = None,
                  deadline_ms: float = 0.0,
-                 priority_of: Optional[Dict[str, int]] = None
+                 priority_of: Optional[Dict[str, int]] = None,
+                 failover: bool = False
                  ) -> Dict[str, object]:
     """Closed-loop burst: ``clients`` threads each loop pick-query →
     POST → record for ``duration_s``.  ``mix`` is ``[(sql, weight)]``;
     clients are assigned principals round-robin from ``principals``
-    (default: one shared "loadtest" tenant).  Returns the aggregate
-    report (see module docstring)."""
+    (default: one shared "loadtest" tenant).  ``failover=True`` is
+    fleet mode: torn requests retry once against surviving workers.
+    Returns the aggregate report (see module docstring)."""
     from mosaic_tpu.obs import metrics
     from mosaic_tpu.obs.context import link_traceparent, new_trace
     from mosaic_tpu.obs.tracer import tracer
@@ -118,6 +187,7 @@ def run_loadtest(host: str, port: int,
     lock = threading.Lock()
     outcomes: Dict[str, int] = {}
     by_principal: Dict[str, Dict[str, int]] = {}
+    counters = ClientCounters()
     lat_key = f"{_HIST}@{time.monotonic_ns()}"  # fresh reservoir per run
 
     def pick(r: float) -> str:
@@ -141,17 +211,21 @@ def run_loadtest(host: str, port: int,
             # bundle (fleet.stitched_traces)
             tp = _w3c_traceparent(rng)
             t0 = time.perf_counter()
+            lost = False
             try:
                 with link_traceparent(tp), \
                         new_trace(f"client:{principal}"):
                     with tracer.span("loadtest/request"):
                         status, reason = _post_query(
                             host, port, sql, principal, priority=prio,
-                            deadline_ms=deadline_ms, traceparent=tp)
+                            deadline_ms=deadline_ms, traceparent=tp,
+                            counters=counters, failover=failover)
             except Exception:
-                status, reason = -1, ""
+                # no answer even after the failover retry (or failover
+                # off): this request is gone for good
+                status, reason, lost = -1, "", True
             dt_ms = (time.perf_counter() - t0) * 1e3
-            b = _bucket(status, reason)
+            b = "lost" if lost else _bucket(status, reason)
             if b == "ok":
                 metrics.observe(lat_key, dt_ms)
             with lock:
@@ -171,6 +245,7 @@ def run_loadtest(host: str, port: int,
     wall = time.perf_counter() - t0
     snap = metrics.report().get("histograms", {}).get(lat_key, {})
     n = sum(outcomes.values())
+    answered = n - outcomes.get("lost", 0)
     return {
         "clients": clients,
         "duration_s": round(wall, 3),
@@ -178,6 +253,13 @@ def run_loadtest(host: str, port: int,
         "qps": round(n / max(1e-9, wall), 1),
         "ok_qps": round(outcomes.get("ok", 0) / max(1e-9, wall), 1),
         "outcomes": dict(sorted(outcomes.items())),
+        # answered / sent: every request the server answered (ok,
+        # denied, shed, ... — an honest 429 is availability, a torn
+        # socket with no retry success is not)
+        "availability": round(answered / max(1, n), 4),
+        "connect_retries": counters.get("connect_retries"),
+        "failovers": counters.get("failovers"),
+        "lost": outcomes.get("lost", 0),
         "by_principal": {p: dict(sorted(v.items()))
                          for p, v in sorted(by_principal.items())},
         "latency_ms": {k: snap.get(k) for k in
@@ -246,6 +328,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--principal", action="append", default=None,
                     help="tenant name (repeat; clients round-robin)")
     ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode: retry a torn-connection request "
+                         "once against surviving workers (failover)")
     ap.add_argument("--curve", action="store_true",
                     help="also sweep the QPS-vs-deadline-miss curve "
                          "(first --sql, needs --deadline-ms)")
@@ -263,7 +348,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     report = run_loadtest(host, port, mix, clients=args.clients,
                           duration_s=args.duration,
                           principals=args.principal,
-                          deadline_ms=args.deadline_ms)
+                          deadline_ms=args.deadline_ms,
+                          failover=args.fleet)
     if args.curve and args.deadline_ms > 0:
         report["deadline_curve"] = deadline_curve(
             host, port, mix[0][0], args.deadline_ms)
