@@ -706,7 +706,23 @@ def main():
         pr0 = metrics.counter_value("store/partitions_pruned")
         ssj = make_store_sharded_pip_join(side, idx, grid, mesh,
                                           polys=polys, chunk=chunk)
-        z_side, _ = ssj(bbox=qbox)
+        # run the pruned query as an accounted query so its
+        # partitions-touched column lands in the workload history
+        # (mosaicstat heatmap reads it offline), and assert the heat
+        # invariant directly: a pruned partition gains zero heat
+        from mosaic_tpu.obs.heat import heat as _heat
+        _side_cold = {p.cell for p in side.partitions} - \
+            {p.cell for p in side.prune(qbox, record=False)}
+        _rows_before = {c["cell"]: c["rows"] for c in
+                        _heat.report(top=1 << 20)["cells"]}
+        with accounted("bench-store-side", principal="tenant-a"):
+            z_side, _ = ssj(bbox=qbox)
+        _rows_after = {c["cell"]: c["rows"] for c in
+                       _heat.report(top=1 << 20)["cells"]}
+        for _cell in _side_cold:
+            assert _rows_after.get(_cell, 0.0) <= \
+                _rows_before.get(_cell, 0.0), \
+                f"pruned partition {_cell} gained heat"
         store_pruned = int(
             metrics.counter_value("store/partitions_pruned") - pr0)
         assert store_pruned > 0, "sub-extent query pruned nothing"
@@ -1188,6 +1204,51 @@ def main():
             f"bench leaked device buffers: {_mem_snap['leaks']}"
         assert record["memory"]["live_bytes_end"] == 0, \
             f"live bytes did not drain: {_mem_snap['totals']}"
+
+    # workload history plane (obs.history / obs.heat): records
+    # written, segment/compaction stats, and the heat skew view.  The
+    # history-smoke lane points MOSAIC_TPU_HISTORY_DIR at one dir for
+    # two rounds, diffs the windows with mosaicstat, and A/Bs
+    # accounted_pass_ms against a history-off run inside the standing
+    # perf-guard slip (history on the completion path costs one JSON
+    # line per query).
+    from mosaic_tpu.obs.heat import heat as _heat
+    from mosaic_tpu.obs.history import history as _history
+    from mosaic_tpu.obs.history import segment_paths as _seg_paths
+    _hdir = _history.directory()
+    record["history"] = {"enabled": bool(_hdir)}
+    if _hdir:
+        _hst = _history.store()
+        if _hst is not None:
+            _hst.rotate()
+            _hcomp = _hst.compact()
+        else:
+            _hcomp = {}
+        _closed, _open = _seg_paths(_hdir)
+        record["history"].update({
+            "records_written": int(obs_rep.get("counters", {})
+                                   .get("history/records_written", 0)),
+            "write_errors": _history.write_errors(),
+            "segments_rotated": int(obs_rep.get("counters", {})
+                                    .get("history/segments_rotated",
+                                         0)),
+            "segments_closed": len(_closed),
+            "segments_open": len(_open),
+            "compacted_records": int(_hcomp.get("records", 0)),
+            "compaction_ratio": round(
+                _hcomp.get("bytes_after", 0)
+                / max(_hcomp.get("bytes_before", 1), 1), 4)
+            if _hcomp.get("segments") else 1.0,
+        })
+    _heat_rep = _heat.report(top=3)
+    record["history"]["heat"] = {
+        "partitions_tracked": _heat_rep["tracked"],
+        "top1_rows_share": round(
+            _heat_rep["cells"][0]["rows"]
+            / max(_heat_rep["total_rows"], 1e-9), 4)
+        if _heat_rep["cells"] else 0.0,
+        "skew": round(_heat_rep["skew"], 3),
+    }
 
     if smoke:
         record["metrics"] = {

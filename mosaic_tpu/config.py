@@ -181,6 +181,29 @@ MOSAIC_STORE_GRID_RES = "mosaic.store.grid.res"
 MOSAIC_STORE_SHARD_ROWS = "mosaic.store.shard.rows"
 MOSAIC_STORE_MMAP = "mosaic.store.mmap"
 
+# Workload history plane (obs/history.py): a durable per-worker store
+# of one record per completed query.  The directory ("" = history
+# off), the rotation thresholds for the append-only open segment
+# (bytes; age in ms, 0 = no age bound), the retained closed-segment
+# cap, and the compaction window width in ms (records aggregate into
+# one summary file per window).
+MOSAIC_HISTORY_DIR = "mosaic.history.dir"
+MOSAIC_HISTORY_SEGMENT_BYTES = "mosaic.history.segment.bytes"
+MOSAIC_HISTORY_SEGMENT_AGE_MS = "mosaic.history.segment.age.ms"
+MOSAIC_HISTORY_RETAIN = "mosaic.history.retain"
+MOSAIC_HISTORY_WINDOW_MS = "mosaic.history.window.ms"
+# Partition heat (obs/heat.py): the exponential half-life of the
+# per-cell access accumulators (0 = never decay), and whether the
+# store-fed join hands the accumulated heat to the skew rebalancer as
+# a placement prior (a pure hint — results stay bit-identical).
+MOSAIC_HEAT_HALFLIFE_MS = "mosaic.heat.halflife.ms"
+MOSAIC_HEAT_PRIOR = "mosaic.heat.prior"
+# Audit-spool bounds (obs/accounting.py): rotate the JSONL spool past
+# this size (0 = unbounded, the historical behaviour) and keep at
+# most this many rotated files.
+MOSAIC_AUDIT_ROTATE_BYTES = "mosaic.audit.rotate.bytes"
+MOSAIC_AUDIT_RETAIN = "mosaic.audit.retain"
+
 MOSAIC_RASTER_CHECKPOINT_DEFAULT = "/tmp/mosaic_tpu/checkpoint"
 MOSAIC_RASTER_TMP_PREFIX_DEFAULT = "/tmp"
 MOSAIC_RASTER_BLOCKSIZE_DEFAULT = 128
@@ -320,6 +343,19 @@ class MosaicConfig:
     store_grid_res: int = 1_024
     store_shard_rows: int = 4_194_304
     store_mmap: bool = True
+    # Workload history plane (obs/history.py); "" = history off.
+    history_dir: str = ""
+    history_segment_bytes: int = 1_048_576
+    history_segment_age_ms: float = 0.0
+    history_retain: int = 64
+    history_window_ms: float = 3_600_000.0
+    # Partition heat (obs/heat.py): accumulator half-life (0 = never
+    # decay) and the opt-in placement prior for the skew rebalancer.
+    heat_halflife_ms: float = 300_000.0
+    heat_prior: bool = False
+    # Audit-spool bounds; rotate_bytes 0 = unbounded spool.
+    audit_rotate_bytes: int = 0
+    audit_retain: int = 8
 
     @staticmethod
     def from_confs(confs: dict) -> "MosaicConfig":
@@ -526,6 +562,15 @@ _CONF_FIELDS = {
     MOSAIC_STORE_GRID_RES: ("store_grid_res", _as_blocksize),
     MOSAIC_STORE_SHARD_ROWS: ("store_shard_rows", _as_blocksize),
     MOSAIC_STORE_MMAP: ("store_mmap", _as_flag),
+    MOSAIC_HISTORY_DIR: ("history_dir", _as_str),
+    MOSAIC_HISTORY_SEGMENT_BYTES: ("history_segment_bytes", _as_blocksize),
+    MOSAIC_HISTORY_SEGMENT_AGE_MS: ("history_segment_age_ms", _as_millis),
+    MOSAIC_HISTORY_RETAIN: ("history_retain", _as_count),
+    MOSAIC_HISTORY_WINDOW_MS: ("history_window_ms", _as_millis),
+    MOSAIC_HEAT_HALFLIFE_MS: ("heat_halflife_ms", _as_millis),
+    MOSAIC_HEAT_PRIOR: ("heat_prior", _as_flag),
+    MOSAIC_AUDIT_ROTATE_BYTES: ("audit_rotate_bytes", _as_bytes),
+    MOSAIC_AUDIT_RETAIN: ("audit_retain", _as_count),
 }
 
 

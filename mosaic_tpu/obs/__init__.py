@@ -57,6 +57,15 @@ shim).  Twelve parts:
   bucket-wise histogram merges) with stale-worker degrade, fleet SLO
   evaluation and cross-process trace stitching via W3C
   ``traceparent`` links (``context.link_traceparent``).
+* ``obs.history`` / ``obs.heat`` — the workload history plane: a
+  crash-safe rotating on-disk store of one record per completed query
+  (``mosaic.history.dir``; append-only JSONL segments, size/age
+  rotation, retention, per-window summary compaction, exact fleet
+  merge via ``fleet.merge_history``) and the per-partition access
+  heat tracker (time-decayed scans/rows/bytes per store cell,
+  ``heat_report()`` skew views, and the opt-in ``mosaic.heat.prior``
+  placement hint for the skew rebalancer).  ``tools/mosaicstat.py``
+  is the operator CLI over the stored history.
 * ``obs.memwatch`` — the device-memory plane: the live-buffer
   :class:`DeviceMemoryLedger` (per-(site, trace, device) bytes,
   ``mem/live_bytes`` / ``mem/pressure`` gauges, per-query peak
@@ -87,7 +96,11 @@ from .context import (TraceContext, current_trace, current_trace_id,
 from .dashboard import serve_dashboard
 from .devicemon import DeviceMonitor, devicemon, mesh_device_keys
 from .fleet import (FleetAggregator, FleetStore, WorkerState,
-                    aggregator_for)
+                    aggregator_for, merge_history)
+from .heat import HeatTracker, heat
+from .history import (HISTORY_VERSION, HistoryStore, history,
+                      window_diff)
+from .history import report as history_report
 from .inflight import (InflightRegistry, QueryCancelled, QueryTicket,
                        checkpoint, inflight)
 from .jaxmon import (STORM_THRESHOLD, install_jax_listeners,
@@ -131,7 +144,10 @@ __all__ = [
     "SPOOL_VERSION", "SpoolError", "read_spool", "spool_snapshot",
     "write_spool",
     "FleetAggregator", "FleetStore", "WorkerState", "aggregator_for",
-    "fleet_to_openmetrics",
+    "fleet_to_openmetrics", "merge_history",
+    "HISTORY_VERSION", "HistoryStore", "history", "history_report",
+    "window_diff",
+    "HeatTracker", "heat",
     "DeviceMonitor", "devicemon", "mesh_device_keys",
     "serve_dashboard",
     "HostProfiler", "KernelLedger", "ledger", "profiler",

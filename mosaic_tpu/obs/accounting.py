@@ -36,7 +36,9 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import glob
 import json
+import os
 import threading
 import time
 from typing import Dict, Iterator, List, Optional
@@ -173,6 +175,7 @@ class AuditLog:
                 with open(path, "a", encoding="utf-8") as fh:
                     fh.write(json.dumps(record, default=str,
                                         sort_keys=True) + "\n")
+                self._maybe_rotate(path)
             except OSError:
                 # retention is best-effort; never fail the query over
                 # a full disk — surface it as a counter instead
@@ -185,6 +188,40 @@ class AuditLog:
     def _spool_path() -> str:
         from .. import config as _config
         return getattr(_config.default_config(), "audit_path", "") or ""
+
+    @staticmethod
+    def _maybe_rotate(path: str) -> None:
+        """Bound the spool: past ``mosaic.audit.rotate.bytes`` the
+        live file renames to ``<path>.<ts>`` and at most
+        ``mosaic.audit.retain`` rotated files survive — a long-lived
+        fleet worker can no longer grow the spool without limit.
+        Rotation trouble is swallowed (same best-effort contract as
+        the write itself)."""
+        from .. import config as _config
+        cfg = _config.default_config()
+        limit = int(getattr(cfg, "audit_rotate_bytes", 0))
+        if limit <= 0:
+            return
+        try:
+            if os.path.getsize(path) < limit:
+                return
+            rotated = f"{path}.{int(time.time() * 1e3):013d}"
+            while os.path.exists(rotated):
+                rotated += "x"
+            os.replace(path, rotated)
+        except OSError:
+            return
+        if metrics.enabled:
+            metrics.count("audit/spool_rotations")
+        retain = int(getattr(cfg, "audit_retain", 8))
+        if retain > 0:
+            old = sorted(p for p in glob.glob(f"{path}.*")
+                         if p[len(path) + 1:].rstrip("x").isdigit())
+            for p in old[:max(0, len(old) - retain)]:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
     # -- reads
     def records(self, principal: Optional[str] = None,
@@ -263,6 +300,13 @@ def complete(ticket: Optional[QueryTicket], outcome: str = "ok",
         record["error"] = f"{type(error).__name__}: {error}"
     inflight.finish(ticket, status=outcome)
     audit.append(record)
+    # the durable workload history (obs/history.py): exactly one
+    # record per completed query — every outcome, partial costs
+    # included — widened with the ticket's mispredict / fusion /
+    # partition columns.  Lazy import: history's fault probe pulls
+    # resilience.faults, which imports obs back.
+    from .history import history as _history
+    _history.record_completion(record, ticket)
     meter.charge(ticket.principal,
                  {"wall_ms": cost["wall_ms"],
                   "device_s": cost["device_s"],

@@ -29,6 +29,11 @@ Routes:
 * ``/api/principals``   — per-principal meter totals (``obs.accounting``)
 * ``/api/server``       — query-server state (``serve/``): queue,
   quotas, per-tenant admission/shed counters
+* ``/api/history``      — workload history (``obs.history``): merged
+  window payloads + totals from ``mosaic.history.dir`` (``?dir=``
+  overrides; ``?window=<ms>`` re-windows), plus the live partition
+  heat report (``obs.heat``); ``{"enabled": False}`` when no history
+  dir is configured
 * ``POST /api/queries/<id>/cancel`` — request cooperative cancellation
   of an in-flight query (POST-only: GET answers 405; an unknown id
   answers a JSON 404)
@@ -247,6 +252,31 @@ def _supervisor_status(directory: str):
         return None
 
 
+def _history_payload(qs: Dict[str, list]) -> Dict[str, object]:
+    """The workload-history panel: merged windows + totals for the
+    history dir (``?dir=`` overrides ``mosaic.history.dir`` / the
+    feed's resolved dir) plus the live heat report.  No dir ->
+    ``{"enabled": False}``; a broken dir degrades to an error field,
+    never a 500 (same stand-alone contract as the fleet panel)."""
+    from .heat import heat
+    from .history import history, report
+    directory = (qs.get("dir") or [""])[0] or history.directory()
+    out: Dict[str, object] = {"heat": heat.report(top=10)}
+    if not directory:
+        out["enabled"] = False
+        return out
+    out["enabled"] = True
+    try:
+        window = (qs.get("window") or [""])[0]
+        out.update(report(directory,
+                          float(window) if window else None))
+        out["write_errors"] = history.write_errors()
+    except Exception as exc:
+        out["dir"] = directory
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
 def _profile_payload(qs: Dict[str, list]) -> Dict[str, object]:
     from .profiler import ledger, profiler
     trace = (qs.get("trace") or [None])[0] or None
@@ -295,6 +325,9 @@ _PAGE = """<!doctype html>
 <h2>Principals</h2><table id="principals"></table>
 <h2>Query server</h2><div id="server">not running</div>
 <table id="servertab"></table>
+<h2>Workload history</h2><div id="history">not configured</div>
+<table id="histwin"></table>
+<h2>Partition heat</h2><table id="heat"></table>
 <script>
 const $=id=>document.getElementById(id);
 async function j(u){const r=await fetch(u);return r.json()}
@@ -379,6 +412,36 @@ async function tick(){
     esc(p)+"</td><td>"+v.queued+"</td><td>"+v.running+"</td><td>"+
     v.admitted+"</td><td"+(v.shed?' class="bad">':">")+v.shed+
     "</td></tr>").join("");
+ }
+ const hi=await j("/api/history");
+ const he=hi.heat||{cells:[]};
+ $("heat").innerHTML="<tr><th>cell</th><th>scans</th><th>rows</th>"+
+  "<th>bytes</th><th>bytes/row</th></tr>"+(he.cells.length?
+  he.cells.map(c=>"<tr><td>"+c.cell+"</td><td>"+c.scans.toFixed(1)+
+   "</td><td>"+c.rows.toFixed(0)+"</td><td>"+c.bytes.toFixed(0)+
+   "</td><td>"+c.bytes_per_row.toFixed(1)+"</td></tr>").join("")
+  :'<tr><td colspan="5" class="ok">no partitions touched</td></tr>');
+ if(!hi.enabled){$("history").textContent="not configured";
+  $("history").className="ok";$("histwin").innerHTML="";}
+ else if(hi.error){$("history").className="bad";
+  $("history").textContent=hi.dir+" — "+hi.error;}
+ else{
+  const tq=(hi.totals||{}).queries||0;
+  $("history").className="ok";
+  $("history").textContent=hi.dir+" — "+tq+" queries in "+
+   (hi.windows||[]).length+" window(s)"+
+   (hi.write_errors?", "+hi.write_errors+" write error(s)":"");
+  $("histwin").innerHTML="<tr><th>window</th><th>queries</th>"+
+   "<th>errors</th><th>p50 ms</th><th>p95 ms</th>"+
+   "<th>mispredicts</th></tr>"+(hi.windows||[]).slice(-8).map(w=>{
+    const op=Object.values(w.operators||{});
+    const p50=op.length?Math.max(...op.map(o=>o.p50_ms)):0;
+    const p95=op.length?Math.max(...op.map(o=>o.p95_ms)):0;
+    const err=(w.outcomes||{}).error||0;
+    return "<tr><td>"+w.window+"</td><td>"+w.queries+"</td><td"+
+     (err?' class="bad">':">")+err+"</td><td>"+
+     p50.toFixed(1)+"</td><td>"+p95.toFixed(1)+"</td><td>"+
+     (w.mispredicts||0)+"</td></tr>"}).join("");
  }
 }
 async function cancelQ(id){
@@ -596,6 +659,8 @@ def serve_dashboard(port: int = 0, addr: str = "127.0.0.1"
                     self._json(_server_payload())
                 elif path == "/api/fleet":
                     self._json(_fleet_payload(qs))
+                elif path == "/api/history":
+                    self._json(_history_payload(qs))
                 elif _CANCEL_RE.match(path):
                     # cancel mutates: POST-only, so a prefetching
                     # browser/crawler can never kill a query

@@ -53,7 +53,7 @@ from .spool import SpoolError, read_spool
 from .timeseries import Series
 
 __all__ = ["WorkerState", "FleetStore", "FleetAggregator",
-           "aggregator_for"]
+           "aggregator_for", "merge_history"]
 
 
 class WorkerState:
@@ -449,3 +449,59 @@ def aggregator_for(directory: str) -> FleetAggregator:
         if agg is None:
             agg = _aggregators[directory] = FleetAggregator(directory)
         return agg
+
+
+def merge_history(directories: List[str],
+                  window_ms: Optional[float] = None
+                  ) -> Dict[str, Any]:
+    """Fleet-wide workload history: every worker's history dir merged
+    window by window with the same exactness discipline as spool
+    merging — histogram buckets sum, so fleet percentiles are computed
+    from the union, never averaged from per-worker percentiles.  An
+    unreadable directory degrades (``fleet_merge_error`` event +
+    ``fleet/merge_errors`` counter) and the rest still merge."""
+    # NB: ``from . import history`` would resolve to the package's
+    # re-exported HistoryFeed singleton, not the submodule
+    from .history import (_resolve_window_ms, merge_summary,
+                          merged_windows, new_summary, summary_payload)
+    windows: Dict[int, Dict[str, Any]] = {}
+    merged_dirs: List[str] = []
+    errors = 0
+    for d in directories:
+        try:
+            per = merged_windows(d, window_ms)
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            errors += 1
+            recorder.record("fleet_merge_error", pid=0, path=d,
+                            error=f"history: {e}")
+            if metrics.enabled:
+                metrics.count("fleet/merge_errors")
+            continue
+        merged_dirs.append(d)
+        for wid, s in per.items():
+            cur = windows.get(wid)
+            if cur is None:
+                windows[wid] = s
+            else:
+                try:
+                    merge_summary(cur, s)
+                except (KeyError, TypeError, ValueError) as e:
+                    errors += 1
+                    recorder.record("fleet_merge_error", pid=0,
+                                    path=d,
+                                    error=f"history window {wid}: {e}")
+                    if metrics.enabled:
+                        metrics.count("fleet/merge_errors")
+    totals = new_summary(None, _resolve_window_ms(window_ms))
+    for wid in sorted(windows):
+        try:
+            merge_summary(totals, windows[wid])
+        except (KeyError, TypeError, ValueError):
+            pass
+    return {
+        "dirs": merged_dirs,
+        "errors": errors,
+        "windows": [summary_payload(windows[w])
+                    for w in sorted(windows)],
+        "totals": summary_payload(totals),
+    }

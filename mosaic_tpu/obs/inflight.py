@@ -46,7 +46,9 @@ from .context import current_trace_id
 __all__ = ["QueryCancelled", "QueryTicket", "InflightRegistry",
            "inflight", "checkpoint", "charge_device_seconds",
            "charge_h2d_bytes", "charge_d2h_bytes", "note_rows",
-           "note_rows_in", "note_strategies", "ticket_observer"]
+           "note_rows_in", "note_strategies", "note_mispredict",
+           "note_fusion_group", "note_partitions",
+           "note_partition_bytes", "ticket_observer"]
 
 _qids = itertools.count(1)
 
@@ -102,6 +104,11 @@ class QueryTicket:
         self.mem_live_bytes = 0      # memwatch ledger: live right now
         self.mem_peak_bytes = 0      # memwatch ledger: high-water mark
         self.strategies: Dict[str, str] = {}   # planner picks per op
+        self.mispredicts = 0         # planner estimates past the factor
+        self.fusion_groups: List[str] = []     # fused groups executed
+        #: store cells touched: cell -> [rows read, bytes staged] (the
+        #: history record's partition-heat columns)
+        self.partitions: Dict[int, List[int]] = {}
         self.status = "running"
         self._cancel_reason: Optional[str] = None
 
@@ -355,3 +362,46 @@ def note_strategies(strategies: Dict[str, str]) -> None:
     t = _active_ticket()
     if t is not None:
         t.strategies.update(strategies)
+
+
+def note_mispredict() -> None:
+    """Count one planner cardinality mispredict against the active
+    ticket (the history record's planner-accuracy column)."""
+    t = _active_ticket()
+    if t is not None:
+        t.mispredicts += 1
+
+
+def note_fusion_group(name: str) -> None:
+    """Record one fused-group execution on the active ticket."""
+    t = _active_ticket()
+    if t is not None:
+        t.fusion_groups.append(str(name))
+
+
+def note_partitions(spans) -> None:
+    """Charge ``(cell, rows)`` store-read spans to the active ticket's
+    partition ledger (the chip-store chunk/partition read paths)."""
+    t = _active_ticket()
+    if t is None:
+        return
+    for cell, rows in spans:
+        e = t.partitions.get(cell)
+        if e is None:
+            t.partitions[cell] = [int(rows), 0]
+        else:
+            e[0] += int(rows)
+
+
+def note_partition_bytes(by_cell) -> None:
+    """Charge per-partition staged bytes (the store-fed join's
+    ``staged_bytes_by_partition`` ledger) to the active ticket."""
+    t = _active_ticket()
+    if t is None:
+        return
+    for cell, nbytes in dict(by_cell).items():
+        e = t.partitions.get(cell)
+        if e is None:
+            t.partitions[cell] = [0, int(nbytes)]
+        else:
+            e[1] += int(nbytes)

@@ -82,6 +82,10 @@ EVENTS = frozenset({
     # bytes on disk than the manifest promised and recovered per the
     # on_error policy)
     "store_shard_torn",
+    # workload history plane (obs/history.py): a segment file failed
+    # validation (torn tail, wrong version, unparseable header) and
+    # was skipped or prefix-truncated instead of raising
+    "history_segment_torn",
     # recorder-internal marks
     "dump", "dump_suppressed", "dump_suppressed_flush", "error",
     "unhandled_error",

@@ -706,6 +706,16 @@ def make_store_sharded_pip_join(store, idx, grid: IndexSystem, mesh,
     cent = {p.cell: ((p.bbox[0] + p.bbox[2]) / 2.0,
                      (p.bbox[1] + p.bbox[3]) / 2.0)
             for p in store.partitions}
+    if default_config().heat_prior:
+        # seed placement from accumulated partition heat (obs/heat.py)
+        # — a pure hint: the rebalancer only moves rows between
+        # shards, so outputs stay bit-identical to an unprimed run
+        from ..obs.heat import heat
+        hp = heat.prior(nbins, store.bbox, cent)
+        if hp is not None:
+            rebalancer.prime(np.asarray(store.bbox, np.float64), hp)
+            if metrics.enabled:
+                metrics.count("heat/prior_primes")
 
     def kernel(rows):
         # shares the in-memory sharded path's cache family: a store
@@ -796,6 +806,14 @@ def make_store_sharded_pip_join(store, idx, grid: IndexSystem, mesh,
         zone_out = np.concatenate(zones) if zones \
             else np.empty(0, np.int32)
         run.staged_bytes_by_partition = staged_by_part
+        if staged_by_part:
+            # per-partition staged bytes feed heat + the query's
+            # durable history record (rows already fed at chunk emit)
+            from ..obs.heat import heat
+            from ..obs.inflight import note_partition_bytes
+            for c, b in staged_by_part.items():
+                heat.touch(c, nbytes=b, scans=0)
+            note_partition_bytes(staged_by_part)
         if metrics.enabled:
             from ..obs.devicemon import devicemon, mesh_device_keys
             devicemon.attribute("pip_join",

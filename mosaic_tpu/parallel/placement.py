@@ -123,6 +123,22 @@ class SkewRebalancer:
         if self.observations % self.refresh == 0:
             self.rebalance()
 
+    def prime(self, bbox, density) -> None:
+        """Seed the lattice from an external heat prior (the decayed
+        per-partition access heat ``obs.heat`` folds into this bin
+        layout) and pack immediately, so the very first chunk places
+        skew-aware instead of identity.  A pure placement hint: only
+        *where* rows compute changes, never what they compute —
+        subsequent ``observe`` feedback decays the prior like any
+        other observation."""
+        d = np.asarray(density, np.float64).ravel()
+        if d.size != self.nbins * self.nbins:
+            raise ValueError(f"prior has {d.size} bins, lattice needs "
+                             f"{self.nbins * self.nbins}")
+        self._bbox = np.asarray(bbox, np.float64).copy()
+        self._density = d.copy()
+        self.rebalance()
+
     def rebalance(self) -> None:
         """Greedy bin-packing: bins in descending density order, each
         onto the currently least-loaded shard."""

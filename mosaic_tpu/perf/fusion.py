@@ -594,7 +594,7 @@ def execute_group(group: FusionGroup, q: Query, env,
     import jax
     from ..obs.devicemon import devicemon
     from ..obs.inflight import (charge_d2h_bytes, charge_h2d_bytes,
-                                checkpoint)
+                                checkpoint, note_fusion_group)
     from ..obs.memwatch import device_keys_of, memwatch
     from ..obs.profiler import ledger
     from ..resilience import faults
@@ -675,6 +675,7 @@ def execute_group(group: FusionGroup, q: Query, env,
         # a cold wall is dominated by the one-off XLA compile and
         # would flip decide_fusion to "unfused" forever
         planner.observe_op(f"fusion/{group.opset}", n, wall)
+    note_fusion_group(group.name)
     recorder.record("fusion_group", name=group.name, rows=n,
                     bucket=bucket, wall_ms=round(wall * 1e3, 3))
 

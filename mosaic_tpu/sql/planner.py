@@ -581,6 +581,10 @@ class Planner:
             if mis:
                 metrics.count("planner/mispredicts")
         if mis:
+            # the active query's ticket carries the mispredict into
+            # its durable history record (obs/history.py)
+            from ..obs.inflight import note_mispredict
+            note_mispredict()
             recorder.record("planner_mispredict", op=op,
                             est_rows=int(est_rows),
                             actual_rows=int(actual_rows),

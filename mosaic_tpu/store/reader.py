@@ -27,6 +27,8 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs import metrics
+from ..obs.heat import heat
+from ..obs.inflight import note_partitions
 from ..obs.recorder import recorder
 from ..resilience import faults
 from ..resilience.ingest import CodecError, ON_ERROR_MODES
@@ -161,12 +163,17 @@ class ChipStore:
         names = list(cols) if cols is not None \
             else list(self.manifest.columns)
         out: Dict[str, List[np.ndarray]] = {c: [] for c in names}
+        read_rows = 0
         for k, rows in enumerate(part.shards):
             arrs = {c: self._read_shard(part.cell, k, c, rows)
                     for c in names}
             usable = min(a.shape[0] for a in arrs.values())
+            read_rows += usable
             for c in names:
                 out[c].append(arrs[c][:usable])
+        # partition-heat feed: this read touches exactly one cell
+        heat.touch(part.cell, rows=read_rows)
+        note_partitions(((part.cell, read_rows),))
         return {c: np.concatenate(segs) if segs else
                 np.empty(0, np.dtype(self.manifest.columns[c]))
                 for c, segs in out.items()}
@@ -238,6 +245,11 @@ class ChipStore:
                                if len(pieces) > 1 else pieces[0],
                                parts=tuple(spans))
             offset += take
+            # partition-heat feed: rows actually streamed per cell (a
+            # pruned partition never reaches a chunk — it stays cold)
+            for cell, r in spans:
+                heat.touch(cell, rows=r)
+            note_partitions(spans)
             if metrics.enabled:
                 metrics.count("store/chunks_streamed")
                 metrics.count("store/rows_scanned", take)

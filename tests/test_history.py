@@ -1,0 +1,491 @@
+"""The workload history plane (``obs/history.py`` + ``obs/heat.py``).
+
+The ISSUE's acceptance surface, directly:
+
+* **exactly one record per completed query** — ok, error, AND
+  cancelled outcomes all land one history record through
+  ``accounting.complete``, widened with mispredicts / fusion groups /
+  partitions touched;
+* **degrade, not die** — torn tails keep their intact prefix, alien
+  versions are skipped whole, a full-disk/injected write fault costs
+  a counter and never the query (``history_segment_torn`` event +
+  ``history/segments_torn`` / ``history/write_errors`` counters);
+* **crash safety** — a ``kill -9`` mid-append leaves the directory
+  loadable with loss confined to the open segment's torn tail, and
+  two pids appending into one directory never collide (per-pid open
+  segments);
+* **exact fleet merge** — N workers' summaries merged window-by-
+  window reproduce the single-store oracle's percentiles and integer
+  counters bit-for-bit;
+* **heat is observational** — the heat prior hands the rebalancer a
+  placement hint only: a primed store-fed join returns bit-identical
+  results to an unprimed one.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from mosaic_tpu import config as _config
+from mosaic_tpu.obs import metrics
+from mosaic_tpu.obs.accounting import accounted, audit, meter
+from mosaic_tpu.obs.heat import HeatTracker, heat
+from mosaic_tpu.obs.history import (HISTORY_VERSION, HistoryStore,
+                                    history, load_records,
+                                    merged_windows, read_segment,
+                                    report, segment_paths,
+                                    summarize_records, summary_paths,
+                                    summary_payload, window_diff)
+from mosaic_tpu.obs.inflight import QueryCancelled, inflight
+from mosaic_tpu.obs.recorder import recorder
+from mosaic_tpu.resilience.testing import fault_plan  # noqa: F401
+from mosaic_tpu.store import ChipStore, write_store
+
+RES = 4096
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def clean_obs(monkeypatch):
+    """Clean obs singletons + pinned-off history env around a test."""
+    monkeypatch.delenv("MOSAIC_TPU_HISTORY_DIR", raising=False)
+    prev = _config.default_config()
+    audit.reset()
+    meter.reset()
+    history.reset()
+    heat.reset()
+    metrics.reset()
+    metrics.enable()
+    recorder.reset()
+    recorder.enable()
+    yield
+    _config.set_default_config(prev)
+    audit.reset()
+    meter.reset()
+    history.reset()
+    heat.reset()
+    metrics.disable()
+    metrics.reset()
+    recorder.reset()
+
+
+def _rec(i, ts=100.0, principal="alice", outcome="ok", wall=5.0,
+         operator="pip_join"):
+    return {"query_id": f"q{i}", "principal": principal,
+            "sql": f"SELECT {i}", "trace": f"t{i}",
+            "start_ts": ts - 0.01, "end_ts": ts, "outcome": outcome,
+            "operator": operator,
+            "strategies": {"join": "bnl" if i % 2 else "hash"},
+            "cost": {"wall_ms": wall, "device_s": 0.25,
+                     "rows_in": 100, "rows_out": 50,
+                     "h2d_bytes": 4096, "d2h_bytes": 128,
+                     "mem_peak_bytes": 1 << 20, "compiles": 1},
+            "mispredicts": i % 3, "fusion_groups": ["pip.fused"],
+            "partitions": {"3": {"rows": 100, "bytes": 800},
+                           "9": {"rows": 2, "bytes": 16}}}
+
+
+# ------------------------------------------------- rotation/retention
+
+def test_append_rotates_and_retains(tmp_path, clean_obs):
+    st = HistoryStore(str(tmp_path), segment_bytes=600, retain=3)
+    for i in range(30):
+        st.append(_rec(i))
+    st.close()
+    closed, opens = segment_paths(str(tmp_path))
+    assert closed and len(closed) <= 3            # retention held
+    assert metrics.counter_value("history/segments_rotated") > 0
+    assert metrics.counter_value("history/segments_dropped") > 0
+    assert metrics.counter_value("history/records_written") == 30
+    # every surviving record is intact and name order is age order
+    for p in closed:
+        for r in read_segment(p):
+            assert r["principal"] == "alice"
+    assert closed == sorted(closed)
+
+
+def test_age_rotation(tmp_path, clean_obs):
+    st = HistoryStore(str(tmp_path), segment_bytes=1 << 20,
+                      segment_age_ms=1.0)
+    st.append(_rec(0))
+    time.sleep(0.02)
+    st.append(_rec(1))                 # over age: rotates first
+    st.close()
+    closed, _ = segment_paths(str(tmp_path))
+    assert len(closed) == 1
+    assert len(read_segment(closed[0])) == 1
+
+
+# ------------------------------------------------------ degrade paths
+
+def test_torn_tail_keeps_prefix(tmp_path, clean_obs):
+    st = HistoryStore(str(tmp_path))
+    for i in range(5):
+        st.append(_rec(i))
+    st.close()
+    path = segment_paths(str(tmp_path))[1][0]
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:len(raw) - 30])   # tear mid-record
+    recs = read_segment(path)
+    assert len(recs) == 4                          # prefix survives
+    assert [r["query_id"] for r in recs] == ["q0", "q1", "q2", "q3"]
+    assert recorder.events("history_segment_torn")
+    assert metrics.counter_value("history/segments_torn") == 1
+
+
+def test_alien_version_segment_skipped_whole(tmp_path, clean_obs):
+    path = tmp_path / "history-123.open.jsonl"
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"history": HISTORY_VERSION + 99,
+                             "pid": 123}) + "\n")
+        fh.write(json.dumps(_rec(0)) + "\n")
+    assert read_segment(str(path)) == []
+    ev = recorder.events("history_segment_torn")
+    assert ev and "version" in ev[-1]["why"]
+    # an unparseable header likewise
+    with open(path, "w") as fh:
+        fh.write("{torn json\n")
+    assert read_segment(str(path)) == []
+    assert metrics.counter_value("history/segments_torn") == 2
+
+
+def test_write_fault_costs_counter_not_query(tmp_path, clean_obs,
+                                             monkeypatch, fault_plan):
+    monkeypatch.setenv("MOSAIC_TPU_HISTORY_DIR", str(tmp_path))
+    fault_plan("seed=23;site=history.write,fails=1")
+    with accounted("join-a", principal="alice"):
+        pass                                      # survives the fault
+    with accounted("join-b", principal="alice"):
+        pass
+    assert metrics.counter_value("history/write_errors") == 1
+    assert history.write_errors() == 1
+    recs = load_records(str(tmp_path))
+    assert len(recs) == 1                         # second one landed
+    assert recs[0]["sql"] == "join-b"
+    assert audit.records(limit=10) and len(audit.records(limit=10)) == 2
+
+
+# -------------------------------------------------------- crash drill
+
+def test_two_pids_one_directory(tmp_path, clean_obs):
+    """Per-pid open segments make concurrent writers collision-free
+    by construction; a reader merges both."""
+    st = HistoryStore(str(tmp_path))
+    st.append(_rec(0))
+    st.close()
+    # fabricate a second live writer's open segment under another pid
+    other = tmp_path / "history-99999999.open.jsonl"
+    with open(other, "w") as fh:
+        fh.write(json.dumps({"history": HISTORY_VERSION,
+                             "pid": 99999999,
+                             "opened_ts": time.time()}) + "\n")
+        fh.write(json.dumps(_rec(1, principal="bob")) + "\n")
+    recs = load_records(str(tmp_path))
+    assert {r["query_id"] for r in recs} == {"q0", "q1"}
+    assert metrics.counter_value("history/segments_torn") == 0
+
+
+def test_sigkill_mid_write_leaves_store_loadable(tmp_path):
+    """kill -9 a writer mid-append: the directory still loads and the
+    loss is confined to the open segment's torn tail."""
+    child = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {_REPO!r})
+from mosaic_tpu.obs.history import HistoryStore
+st = HistoryStore({str(tmp_path)!r}, segment_bytes=2000)
+i = 0
+while True:
+    st.append({{"query_id": f"q{{i}}", "principal": "p",
+               "outcome": "ok", "end_ts": 100.0, "operator": "scan",
+               "cost": {{"wall_ms": 1.0}}}})
+    i += 1
+"""],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        closed, _ = segment_paths(str(tmp_path))
+        if len(closed) >= 2:           # it rotated at least twice
+            break
+        time.sleep(0.05)
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait()
+    closed, opens = segment_paths(str(tmp_path))
+    assert len(closed) >= 2
+    recs = load_records(str(tmp_path))   # must not raise
+    assert recs and all(r["principal"] == "p" for r in recs)
+    # closed segments were published with fsync+rename: never torn
+    closed_recs = sum(len(read_segment(p)) for p in closed)
+    assert closed_recs > 0
+
+
+# --------------------------------------------- compaction/fleet merge
+
+def test_compaction_matches_in_memory_oracle(tmp_path, clean_obs):
+    st = HistoryStore(str(tmp_path), window_ms=1_000.0)
+    recs = [_rec(i, ts=100.0 + (i % 3), wall=float(2 ** (i % 8)))
+            for i in range(40)]
+    for r in recs:
+        st.append(r)
+    st.rotate()
+    stats = st.compact()
+    st.close()
+    assert stats["records"] == 40 and stats["summaries"] == 3
+    assert not segment_paths(str(tmp_path))[0]    # segments gone
+    assert len(summary_paths(str(tmp_path))) == 3
+    assert metrics.counter_value("history/segments_compacted") > 0
+    oracle = summarize_records(recs, 1_000.0)
+    got = merged_windows(str(tmp_path), 1_000.0)
+    assert set(got) == set(oracle)
+    for wid in oracle:
+        assert summary_payload(got[wid]) == summary_payload(oracle[wid])
+
+
+def test_fleet_merge_equals_single_oracle_bit_for_bit(tmp_path,
+                                                      clean_obs):
+    """Split one workload across three 'workers'; the fleet merge must
+    reproduce the single-store summary exactly — histogram buckets
+    sum, so percentiles and every integer counter are bit-equal."""
+    from mosaic_tpu.obs.fleet import merge_history
+    recs = [_rec(i, ts=100.0 + (i % 2),
+                 principal=("alice", "bob", "carol")[i % 3],
+                 outcome=("ok", "ok", "error", "cancelled")[i % 4],
+                 wall=float(3 ** (i % 6)))
+            for i in range(60)]
+    dirs = []
+    for w in range(3):
+        d = tmp_path / f"worker{w}"
+        st = HistoryStore(str(d), window_ms=1_000.0)
+        for r in recs[w::3]:
+            st.append(r)
+        st.rotate()
+        if w == 1:
+            st.compact()               # mixed: summaries + segments
+        st.close()
+        dirs.append(str(d))
+    merged = merge_history(dirs, window_ms=1_000.0)
+    assert merged["errors"] == 0
+    oracle = summarize_records(recs, 1_000.0)
+    want = [summary_payload(oracle[w]) for w in sorted(oracle)]
+    assert merged["windows"] == want
+    totals = merged["totals"]
+    assert totals["queries"] == 60
+    assert totals["outcomes"] == {"cancelled": 15, "error": 15,
+                                  "ok": 30}
+    # unreadable dir degrades, the rest still merge
+    bad = merge_history(dirs + [str(tmp_path / "nope")],
+                        window_ms=1_000.0)
+    assert bad["totals"]["queries"] == 60
+
+
+def test_window_diff_flags_regression(clean_obs):
+    a = summarize_records([_rec(i, ts=1.0, wall=10.0)
+                           for i in range(20)], 1_000.0)[1]
+    b = summarize_records([_rec(i, ts=2.5, wall=30.0)
+                           for i in range(20)], 1_000.0)[2]
+    d = window_diff(summary_payload(a), summary_payload(b))
+    assert d["flagged"] == ["pip_join"]
+    assert d["operators"]["pip_join"]["slip_p50"] > 0.20
+    # and a flat pair is quiet
+    d2 = window_diff(summary_payload(a), summary_payload(a))
+    assert d2["flagged"] == []
+
+
+# ------------------------------------------------------------ the feed
+
+def test_one_record_per_query_all_outcomes(tmp_path, clean_obs,
+                                           monkeypatch):
+    monkeypatch.setenv("MOSAIC_TPU_HISTORY_DIR", str(tmp_path))
+    with accounted("ok-query", principal="alice"):
+        pass
+    with pytest.raises(RuntimeError):
+        with accounted("err-query", principal="alice"):
+            raise RuntimeError("boom")
+    with pytest.raises(QueryCancelled):
+        with accounted("cancel-query", principal="alice") as t:
+            inflight.cancel(t.query_id)
+            from mosaic_tpu.obs.inflight import checkpoint
+            checkpoint("test")
+    recs = load_records(str(tmp_path))
+    assert len(recs) == 3                 # exactly one per query
+    by_name = {r["sql"]: r for r in recs}
+    assert by_name["ok-query"]["outcome"] == "ok"
+    assert by_name["err-query"]["outcome"] == "error"
+    assert by_name["cancel-query"]["outcome"] == "cancelled"
+    for r in recs:                        # widened columns present
+        assert "mispredicts" in r and "fusion_groups" in r \
+            and "partitions" in r
+        assert set(r["cost"]) >= {"wall_ms", "device_s", "rows_in",
+                                  "rows_out", "h2d_bytes", "d2h_bytes",
+                                  "mem_peak_bytes", "compiles"}
+    assert metrics.counter_value("history/records_written") == 3
+
+
+def test_feed_off_by_default_and_follows_conf(tmp_path, clean_obs):
+    with accounted("q", principal="alice"):
+        pass
+    assert history.store() is None        # "" = plane off
+    cfg = _config.MosaicConfig.from_confs(
+        {"mosaic.history.dir": str(tmp_path)})
+    _config.set_default_config(cfg)
+    with accounted("q2", principal="alice"):
+        pass
+    assert [r["sql"] for r in load_records(str(tmp_path))] == ["q2"]
+
+
+# ------------------------------------------------------------- heat
+
+def test_heat_report_ranks_and_decays(clean_obs):
+    ht = HeatTracker(halflife_ms=0)       # no decay
+    now = 1_000.0
+    for _ in range(9):
+        ht.touch(3, rows=100, nbytes=800, now=now)
+    ht.touch(7, rows=10, nbytes=40, now=now)
+    rep = ht.report(now=now)
+    assert rep["tracked"] == 2
+    assert [c["cell"] for c in rep["cells"]] == [3, 7]
+    assert rep["cells"][0]["bytes_per_row"] == pytest.approx(8.0)
+    assert rep["skew"] > 1.5
+    # decay: after one half-life the hot cell halves
+    ht2 = HeatTracker(halflife_ms=1_000.0)
+    ht2.touch(3, rows=100, now=now)
+    rep2 = ht2.report(now=now + 1.0)
+    assert rep2["cells"][0]["rows"] == pytest.approx(50.0)
+    assert metrics.counter_value("heat/touches") == 11
+
+
+def test_store_scan_feeds_heat_and_pruned_stays_cold(tmp_path,
+                                                     clean_obs):
+    rng = np.random.default_rng(5)
+    pts = np.column_stack([rng.uniform(-74.3, -73.7, 8_000),
+                           rng.uniform(40.5, 40.95, 8_000)])
+    write_store(str(tmp_path), pts, grid_res=RES, shard_rows=1024)
+    st = ChipStore(str(tmp_path))
+    bbox = (-74.05, 40.6, -73.9, 40.75)
+    scanned = {p.cell for p in st.prune(bbox, record=False)}
+    pruned = {p.cell for p in st.partitions} - scanned
+    assert scanned and pruned
+    for _ in st.iter_chunks(bbox=bbox, chunk_rows=1024):
+        pass
+    rep = heat.report(top=len(st.partitions))
+    hot = {c["cell"] for c in rep["cells"]}
+    assert hot and hot <= scanned          # pruned cells stay cold
+    assert not (hot & pruned)
+
+
+def test_heat_prior_is_pure_hint_bit_parity(tmp_path, clean_obs):
+    """A heat-primed store-fed join returns results bit-identical to
+    an unprimed run — the prior moves placement only."""
+    from mosaic_tpu.bench.workloads import build_workload
+    from mosaic_tpu.parallel.pip_join import (build_pip_index,
+                                              make_store_sharded_pip_join)
+    polys, grid, res = build_workload(n_side=4, res_cells=64)
+    idx = build_pip_index(polys, res, grid)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
+    rng = np.random.default_rng(6)
+    pts = np.column_stack([rng.uniform(-74.3, -73.7, 12_000),
+                           rng.uniform(40.5, 40.95, 12_000)])
+    write_store(str(tmp_path), pts, grid_res=RES, shard_rows=2048)
+    st = ChipStore(str(tmp_path))
+
+    def run():
+        sj = make_store_sharded_pip_join(st, idx, grid, mesh,
+                                         polys=polys, chunk=4096)
+        return sj()
+
+    zone_cold, rc_cold = run()             # also seeds the heat map
+    assert heat.report()["tracked"] > 0
+    cfg = _config.MosaicConfig.from_confs({"mosaic.heat.prior": "true"})
+    _config.set_default_config(cfg)
+    zone_hot, rc_hot = run()
+    assert metrics.counter_value("heat/prior_primes") >= 1
+    assert np.array_equal(np.asarray(zone_cold), np.asarray(zone_hot))
+    assert rc_cold == rc_hot
+
+
+def test_rebalancer_prime_validates_shape(clean_obs):
+    from mosaic_tpu.parallel.placement import SkewRebalancer
+    rb = SkewRebalancer(n_shards=4, nbins=8)
+    with pytest.raises(ValueError):
+        rb.prime((0.0, 0.0, 1.0, 1.0), np.ones(7))
+    rb.prime((0.0, 0.0, 1.0, 1.0), np.ones(64))
+    assert rb.rebalances == 1 and rb._assign is not None
+
+
+# --------------------------------------------------- audit rotation
+
+def test_audit_spool_rotation_and_retention(tmp_path, clean_obs):
+    spool = tmp_path / "audit.jsonl"
+    cfg = _config.MosaicConfig.from_confs({
+        "mosaic.audit.path": str(spool),
+        "mosaic.audit.rotate.bytes": "256",
+        "mosaic.audit.retain": "2"})
+    _config.set_default_config(cfg)
+    for i in range(12):
+        with accounted(f"q{i}", principal="alice"):
+            pass
+    rotated = [p for p in os.listdir(tmp_path)
+               if p.startswith("audit.jsonl.")]
+    assert rotated and len(rotated) <= 2          # cap held
+    assert metrics.counter_value("audit/spool_rotations") >= 3
+    for p in rotated:                             # every line intact
+        for line in open(tmp_path / p):
+            assert json.loads(line)["outcome"] == "ok"
+
+
+# ------------------------------------------------- operator surfaces
+
+def test_mosaicstat_cli_and_diff_gate(tmp_path, clean_obs):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import mosaicstat
+    finally:
+        sys.path.pop(0)
+    st = HistoryStore(str(tmp_path), window_ms=1_000.0)
+    for i in range(10):
+        st.append(_rec(i, ts=1.0, wall=10.0))
+    for i in range(10):
+        st.append(_rec(i + 10, ts=2.5, wall=40.0))
+    st.close()
+    base = ["--dir", str(tmp_path), "--window-ms", "1000"]
+    assert mosaicstat.main(base + ["top", "--by", "wall_ms"]) == 0
+    assert mosaicstat.main(base + ["principals"]) == 0
+    assert mosaicstat.main(base + ["strategies"]) == 0
+    assert mosaicstat.main(base + ["heatmap"]) == 0
+    assert mosaicstat.main(base + ["report"]) == 0
+    assert mosaicstat.main(base + ["diff"]) == 3   # gated regression
+    # two dirs merge fleet-wide through the same CLI
+    assert mosaicstat.main(["--dir", str(tmp_path), "--dir",
+                            str(tmp_path), "--window-ms", "1000",
+                            "principals"]) == 0
+    assert mosaicstat.main(["--dir", str(tmp_path / "void"),
+                            "--window-ms", "1000", "top"]) == 1
+
+
+def test_dashboard_history_endpoint(tmp_path, clean_obs, monkeypatch):
+    import urllib.request
+    from mosaic_tpu.obs.dashboard import serve_dashboard
+    monkeypatch.setenv("MOSAIC_TPU_HISTORY_DIR", str(tmp_path))
+    with accounted("q-dash", principal="alice"):
+        pass
+    heat.touch(5, rows=42, nbytes=84)
+    handle = serve_dashboard(port=0)
+    try:
+        url = f"http://127.0.0.1:{handle.port}/api/history"
+        payload = json.loads(urllib.request.urlopen(url).read())
+        assert payload["enabled"] is True
+        assert payload["totals"]["queries"] == 1
+        assert payload["heat"]["cells"][0]["cell"] == 5
+        # unconfigured -> stand-alone contract
+        monkeypatch.setenv("MOSAIC_TPU_HISTORY_DIR", "")
+        payload = json.loads(urllib.request.urlopen(url).read())
+        assert payload["enabled"] is False
+    finally:
+        handle.close()

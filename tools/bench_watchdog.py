@@ -60,7 +60,12 @@ HIGHER_BETTER = ["value", "knn_rows_per_sec", "sharded_pts_per_sec"]
 # creeping peak is exactly the slow leak the trend table exists to
 # surface.  Dotted keys reach into nested record blocks.
 TREND_ONLY = ["memory.flagship_peak_bytes",
-              "memory.flagship_peak_bytes_per_row"]
+              "memory.flagship_peak_bytes_per_row",
+              # workload history plane: write volume and compaction
+              # yield drift, plus the partition-heat skew trajectory
+              "history.records_written",
+              "history.compaction_ratio",
+              "history.heat.skew"]
 
 # Out-of-core store metrics (the bench's "store" block, first recorded
 # in BENCH_r07): trended from their first appearance, but they join
