@@ -210,6 +210,7 @@ def perf_guard(current: dict, platform: str, slip: float = 0.20,
     tags = "+".join(tag for tag, _ in hist)
     lower_better = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
                     "planner_flagship_ms", "fused_flagship_ms",
+                    "refined_flagship_ms",
                     "serving_p95_ms",
                     "sharded_end_to_end_ms",
                     "tessellate_zones_s",
@@ -918,6 +919,110 @@ def main():
         f"({unfused_ms / fused_ms:.2f}x); project fused "
         f"{pf_ms:.2f} ms vs {pu_ms:.2f} ms; parity 0; warm compiles 0")
 
+    # ------------------------------ adaptive refinement A/B
+    # Engineered skew: a tight cluster of small zones sharing coarse
+    # grid cells (high per-cell chip duplication) plus a point mass on
+    # the cluster — the workload the adaptive refinement
+    # (parallel/pip_join.make_refined_pip_join) exists for.  Pinned
+    # refined vs flat through mosaic.planner.force.refine, both warm
+    # before timing; parity is asserted bit for bit (refinement is a
+    # strategy transform, never an answer transform) and the warm
+    # refined reps assert zero kernel-cache compiles — one compile per
+    # (level, pow2 bucket), already cached.  A final un-pinned run
+    # records the planner's own (auto) decision so the lane is never
+    # vacuously green.
+    from mosaic_tpu.core.geometry.array import \
+        GeometryBuilder as _GeomBuilder
+    from mosaic_tpu.parallel.pip_join import make_refined_pip_join
+
+    def _pin_refine(mode):
+        _config.set_default_config(_config.apply_conf(
+            _config.default_config(), "mosaic.planner.force.refine",
+            mode))
+
+    refine_n = (1 << 14) if smoke else (1 << 19)
+    refine_res = 5
+    _rrng = np.random.default_rng(1292)
+    _rb = _GeomBuilder()
+    for _cx, _cy in _rrng.uniform(-0.1, 0.1, size=(48, 2)):
+        _ang = np.linspace(0.0, 2.0 * np.pi, 8)[:-1]
+        _rb.add_polygon(np.stack([_cx + 0.004 * np.cos(_ang),
+                                  _cy + 0.004 * np.sin(_ang)], 1), [])
+    rpolys = _rb.finish()
+    # 3/4 of the points on the cluster, the rest spread wide
+    _rhot = refine_n * 3 // 4
+    rpts = np.concatenate([
+        _rrng.uniform(-0.12, 0.12, size=(_rhot, 2)),
+        _rrng.uniform(-2.0, 2.0, size=(refine_n - _rhot, 2))])
+    refine_rec = {"n": refine_n, "base_res": refine_res}
+    with tracer.span("bench/refine_ab"):
+        rjoin = make_refined_pip_join(rpolys, grid, refine_res,
+                                      chunk=chunk)
+        _pin_refine("refined")
+        rjoin(rpts)             # cold: probe + deep level + compiles
+        _rkc0 = kernel_cache.stats()
+        z_ref, rtimes = None, []
+        for _ in range(3 if smoke else 5):
+            t0 = time.time()
+            z_ref, _ = rjoin(rpts)
+            rtimes.append(time.time() - t0)
+        _rkc1 = kernel_cache.stats()
+        refined_ms = float(np.median(rtimes)) * 1e3
+        refine_warm_compiles = int(_rkc1["misses"] - _rkc0["misses"])
+        assert refine_warm_compiles == 0, \
+            f"warm refined reps compiled {refine_warm_compiles}x"
+        rstats = dict(rjoin.stats)
+        _pin_refine("flat")
+        rjoin(rpts)             # warm the flat path at this shape
+        z_flat, ftimes = None, []
+        for _ in range(3 if smoke else 5):
+            t0 = time.time()
+            z_flat, _ = rjoin(rpts)
+            ftimes.append(time.time() - t0)
+        flat_ms = float(np.median(ftimes)) * 1e3
+        refine_par = int(np.sum(np.asarray(z_ref)
+                                != np.asarray(z_flat)))
+        assert refine_par == 0, \
+            "refinement parity broke on the skewed workload"
+        _pin_refine("auto")
+        rjoin(rpts)             # the planner's own call, on coefficients
+        _rd = rjoin.last_decision
+    _rcells = int(rstats.get("cells_refined", 0))
+    _rflat_cells = int(rstats.get("cells_flat", 0))
+    refine_rec.update({
+        "refined_flagship_ms": round(refined_ms, 2),
+        "flat_flagship_ms": round(flat_ms, 2),
+        "speedup": round(flat_ms / refined_ms, 3) if refined_ms
+        else None,
+        "parity_mismatches": refine_par,
+        "levels": rstats.get("levels"),
+        "cells_refined": _rcells,
+        "cells_flat": _rflat_cells,
+        "cells_refined_frac": round(
+            _rcells / max(1, _rcells + _rflat_cells), 4),
+        "refined_points": int(rstats.get("refined_points", 0)),
+        "warm_compiles": refine_warm_compiles,
+        "decision": {"strategy": _rd.strategy if _rd else None,
+                     "reason": _rd.reason if _rd else None,
+                     "forced": bool(_rd.forced) if _rd else None}})
+    log(f"refine A/B n={refine_n}: refined {refined_ms:.2f} ms vs "
+        f"flat {flat_ms:.2f} ms ({flat_ms / refined_ms:.2f}x); "
+        f"levels {rstats.get('levels')}, "
+        f"{_rcells}/{_rcells + _rflat_cells} cells refined; parity 0; "
+        f"auto decision {_rd.strategy if _rd else '?'}")
+
+    # learned layout advisor (sql/layout.py): the recommendation the
+    # run's own evidence produces — heat-plane totals/skew from the
+    # store stage's reads; chosen_res is watchdog-trended so a drifting
+    # workload (or advisor) shows up round over round
+    from mosaic_tpu.sql.layout import advise_layout as _advise_layout
+    _ladv = _advise_layout()
+    layout_rec = {"chosen_res": _ladv.grid_res,
+                  "shard_rows": _ladv.shard_rows,
+                  "reason": _ladv.reason}
+    log(f"layout advisor: res {_ladv.grid_res}, shard "
+        f"{_ladv.shard_rows} ({_ladv.reason})")
+
     # ---- serving: the multi-tenant query frontend under load ------
     # Boot the real server over the same warm session and drive it
     # with the loadtest's closed-loop clients: 8 concurrent clients,
@@ -1128,6 +1233,17 @@ def main():
         # perf guard
         "fusion": fusion_rec,
         "fused_flagship_ms": fusion_rec["fused_flagship_ms"],
+        # adaptive join refinement A/B (parallel/pip_join.
+        # make_refined_pip_join): pinned refined vs flat on the
+        # engineered-skew workload, parity- and compile-asserted
+        # above; refined_flagship_ms joins the perf guard and
+        # refine.cells_refined_frac is watchdog-trended
+        "refine": refine_rec,
+        "refined_flagship_ms": refine_rec["refined_flagship_ms"],
+        # learned layout advisor (sql/layout.py): the grid the run's
+        # own workload evidence recommends; layout.chosen_res is
+        # watchdog-trended
+        "layout": layout_rec,
         # out-of-core chip store (mosaic_tpu/store/): on-disk flagship
         # line — ingest vs query reported separately, pruning + parity
         # proven, peak live bytes vs dataset size; store.ingest_s /

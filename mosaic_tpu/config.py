@@ -198,6 +198,25 @@ MOSAIC_HISTORY_WINDOW_MS = "mosaic.history.window.ms"
 # a placement prior (a pure hint — results stay bit-identical).
 MOSAIC_HEAT_HALFLIFE_MS = "mosaic.heat.halflife.ms"
 MOSAIC_HEAT_PRIOR = "mosaic.heat.prior"
+# Adaptive PIP refinement (parallel/pip_join.py): per-cell second-level
+# tessellation of the dense border cells only.  A pure strategy
+# transform — bit-identical to the flat single-level join.  `enabled`
+# is the kill switch (beats any planner pin), `depth` the extra levels
+# the dense cells deepen by, `dup.threshold` the per-cell candidate
+# count below which a cell never refines, `max.cells` the cap on the
+# refined set, and `sample.rows` how many leading rows feed the
+# selectivity probe that picks the dense cells.
+MOSAIC_JOIN_REFINE_ENABLED = "mosaic.join.refine.enabled"
+MOSAIC_JOIN_REFINE_DEPTH = "mosaic.join.refine.depth"
+MOSAIC_JOIN_REFINE_DUP_THRESHOLD = "mosaic.join.refine.dup.threshold"
+MOSAIC_JOIN_REFINE_MAX_CELLS = "mosaic.join.refine.max.cells"
+MOSAIC_JOIN_REFINE_SAMPLE_ROWS = "mosaic.join.refine.sample.rows"
+# Learned layout advisor (sql/layout.py): target occupied-cell row
+# count the advisor sizes ``store.grid.res`` for, and the inclusive
+# resolution clamp it never strays outside of.
+MOSAIC_LAYOUT_ROWS_PER_CELL = "mosaic.layout.rows.per.cell"
+MOSAIC_LAYOUT_MIN_RES = "mosaic.layout.min.res"
+MOSAIC_LAYOUT_MAX_RES = "mosaic.layout.max.res"
 # Audit-spool bounds (obs/accounting.py): rotate the JSONL spool past
 # this size (0 = unbounded, the historical behaviour) and keep at
 # most this many rotated files.
@@ -353,6 +372,18 @@ class MosaicConfig:
     # decay) and the opt-in placement prior for the skew rebalancer.
     heat_halflife_ms: float = 300_000.0
     heat_prior: bool = False
+    # Adaptive PIP refinement — see the mosaic.join.refine.* key
+    # comments above.  Bit-identical either way; `enabled` off beats
+    # any planner pin.
+    join_refine_enabled: bool = True
+    join_refine_depth: int = 1
+    join_refine_dup_threshold: int = 8
+    join_refine_max_cells: int = 4_096
+    join_refine_sample_rows: int = 65_536
+    # Learned layout advisor (sql/layout.py) — see mosaic.layout.*.
+    layout_rows_per_cell: int = 65_536
+    layout_min_res: int = 64
+    layout_max_res: int = 16_384
     # Audit-spool bounds; rotate_bytes 0 = unbounded spool.
     audit_rotate_bytes: int = 0
     audit_retain: int = 8
@@ -569,6 +600,18 @@ _CONF_FIELDS = {
     MOSAIC_HISTORY_WINDOW_MS: ("history_window_ms", _as_millis),
     MOSAIC_HEAT_HALFLIFE_MS: ("heat_halflife_ms", _as_millis),
     MOSAIC_HEAT_PRIOR: ("heat_prior", _as_flag),
+    MOSAIC_JOIN_REFINE_ENABLED: ("join_refine_enabled", _as_flag),
+    MOSAIC_JOIN_REFINE_DEPTH: ("join_refine_depth", _as_blocksize),
+    MOSAIC_JOIN_REFINE_DUP_THRESHOLD:
+        ("join_refine_dup_threshold", _as_count),
+    MOSAIC_JOIN_REFINE_MAX_CELLS:
+        ("join_refine_max_cells", _as_blocksize),
+    MOSAIC_JOIN_REFINE_SAMPLE_ROWS:
+        ("join_refine_sample_rows", _as_blocksize),
+    MOSAIC_LAYOUT_ROWS_PER_CELL:
+        ("layout_rows_per_cell", _as_blocksize),
+    MOSAIC_LAYOUT_MIN_RES: ("layout_min_res", _as_blocksize),
+    MOSAIC_LAYOUT_MAX_RES: ("layout_max_res", _as_blocksize),
     MOSAIC_AUDIT_ROTATE_BYTES: ("audit_rotate_bytes", _as_bytes),
     MOSAIC_AUDIT_RETAIN: ("audit_retain", _as_count),
 }

@@ -35,8 +35,8 @@ from ..types import ChipSet
 from .geometry.array import GeometryArray, GeometryBuilder, GeometryType
 from .index.base import IndexSystem
 
-__all__ = ["tessellate", "polyfill", "point_chips", "convex_clip_rings",
-           "classify_cells"]
+__all__ = ["tessellate", "tessellate_subset", "polyfill", "point_chips",
+           "convex_clip_rings", "classify_cells"]
 
 
 # --------------------------------------------------------------- primitives
@@ -964,6 +964,30 @@ def tessellate(arr: GeometryArray, res: int, grid: IndexSystem,
         else:
             raise ValueError(f"unsupported geometry type {t}")
     return ChipSet.concat(parts_out)
+
+
+def tessellate_subset(arr: GeometryArray, geom_ids: np.ndarray,
+                      res: int, grid: IndexSystem,
+                      keep_core_geom: bool = True
+                      ) -> Tuple[GeometryArray, ChipSet]:
+    """Tessellate only ``geom_ids`` of ``arr`` at ``res``.
+
+    Returns ``(sub_arr, chips)`` where ``sub_arr = arr.take(geom_ids)``
+    and ``chips.geom_id`` is **subset-local**: chip ``geom_id == j``
+    refers to ``arr``'s geometry ``geom_ids[j]``.  Callers that need
+    original ids remap with ``np.asarray(geom_ids)[chips.geom_id]``;
+    indexes built over ``chips`` (e.g. ``build_pip_index(sub_arr, ...)``)
+    likewise resolve zones in subset space and remap the same way.
+    ``geom_ids`` order is preserved, so first-match semantics over the
+    subset agree with first-match over ``arr`` restricted to the subset.
+
+    The adaptive PIP refinement (``make_refined_pip_join``) uses this
+    to deepen only the dense cells' polygons one level down without
+    re-tessellating the whole batch.
+    """
+    geom_ids = np.asarray(geom_ids, dtype=np.int64).reshape(-1)
+    sub = arr.take(geom_ids)
+    return sub, tessellate(sub, res, grid, keep_core_geom=keep_core_geom)
 
 
 def _line_cells_mask(verts, counts, edges) -> np.ndarray:

@@ -283,6 +283,11 @@ def complete(ticket: Optional[QueryTicket], outcome: str = "ok",
         "d2h_bytes": int(ticket.d2h_bytes),
         "mem_peak_bytes": int(ticket.mem_peak_bytes),
         "compiles": compiles,
+        # adaptive-refinement columns (0 for queries that never ran a
+        # refined join); history's fixed cost fold ignores them, the
+        # raw records and the audit log carry them verbatim
+        "cells_refined": int(ticket.refine.get("cells_refined", 0)),
+        "cells_flat": int(ticket.refine.get("cells_flat", 0)),
     }
     record: Dict[str, object] = {
         "query_id": ticket.query_id,

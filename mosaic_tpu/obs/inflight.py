@@ -48,7 +48,7 @@ __all__ = ["QueryCancelled", "QueryTicket", "InflightRegistry",
            "charge_h2d_bytes", "charge_d2h_bytes", "note_rows",
            "note_rows_in", "note_strategies", "note_mispredict",
            "note_fusion_group", "note_partitions",
-           "note_partition_bytes", "ticket_observer"]
+           "note_partition_bytes", "note_refine", "ticket_observer"]
 
 _qids = itertools.count(1)
 
@@ -109,6 +109,13 @@ class QueryTicket:
         #: store cells touched: cell -> [rows read, bytes staged] (the
         #: history record's partition-heat columns)
         self.partitions: Dict[int, List[int]] = {}
+        #: adaptive-refinement counters (cells_refined / cells_flat /
+        #: refined_points / flat_points), accumulated over every
+        #: refined join the query ran — the cost vector's refine columns
+        self.refine: Dict[str, int] = {}
+        #: per-call refinement summaries: (operator at call time,
+        #: summary string) — EXPLAIN ANALYZE's refine column
+        self.refine_ops: List[tuple] = []
         self.status = "running"
         self._cancel_reason: Optional[str] = None
 
@@ -145,6 +152,8 @@ class QueryTicket:
             "d2h_bytes": int(self.d2h_bytes),
             "mem_live_bytes": int(self.mem_live_bytes),
             "mem_peak_bytes": int(self.mem_peak_bytes),
+            "cells_refined": int(self.refine.get("cells_refined", 0)),
+            "cells_flat": int(self.refine.get("cells_flat", 0)),
         }
 
     def snapshot(self) -> Dict[str, object]:
@@ -362,6 +371,25 @@ def note_strategies(strategies: Dict[str, str]) -> None:
     t = _active_ticket()
     if t is not None:
         t.strategies.update(strategies)
+
+
+def note_refine(stats: Dict[str, int],
+                summary: Optional[str] = None) -> None:
+    """Accumulate one refined-join run's counters (``cells_refined``,
+    ``cells_flat``, ``refined_points``, ``flat_points``) on the active
+    ticket and, when ``summary`` is given, append it to the per-call
+    refinement log under the operator the query is currently in — the
+    EXPLAIN ANALYZE ``refine`` column's source."""
+    t = _active_ticket()
+    if t is None:
+        return
+    for k, v in dict(stats).items():
+        try:
+            t.refine[k] = t.refine.get(k, 0) + int(v)
+        except (TypeError, ValueError):
+            pass                      # non-scalar stats stay off the sum
+    if summary:
+        t.refine_ops.append((t.operator, str(summary)))
 
 
 def note_mispredict() -> None:

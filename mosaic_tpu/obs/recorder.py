@@ -64,6 +64,12 @@ EVENTS = frozenset({
     "planner_decision", "planner_mispredict", "planner_stats_loaded",
     "planner_stats_corrupt", "planner_stats_save_failed",
     "fusion_group", "fusion_bailout", "fusion_plan_error",
+    # adaptive PIP refinement (parallel/pip_join.py): a refined run
+    # failed mid-flight and transparently re-ran on the flat path
+    "refine_bailout",
+    # learned layout advisor (sql/layout.py): one store-layout
+    # recommendation, with the evidence it was derived from
+    "layout_advice",
     # memory plane
     "mem_admit_denied", "mem_chunk_shrink", "mem_leak",
     # query service (serve/): overload shedding + drain lifecycle

@@ -215,12 +215,19 @@ def pip_assign(points: jnp.ndarray, cells: jnp.ndarray, idx: PIPIndex,
     points within eps of a chip boundary — the float64 host recheck set.
     """
     n = points.shape[0]
-    slot, in_core = lookup(idx.core_cells, cells)
-    zone = jnp.where(in_core, idx.core_zone[slot], jnp.int32(-1))
+    # size-0 tables are legal (a workload can tessellate to border-only
+    # chips, or — under adaptive refinement — a sub-level can come out
+    # core-only); lookup() already returns found=False there, but the
+    # zone/edge gathers need static guards too.
+    if idx.core_cells.shape[0]:
+        slot, in_core = lookup(idx.core_cells, cells)
+        zone = jnp.where(in_core, idx.core_zone[slot], jnp.int32(-1))
+    else:
+        zone = jnp.full(n, -1, jnp.int32)
 
     b0, in_border = lookup(idx.border_cells, cells)
     uncertain = jnp.zeros(n, bool)
-    for d in range(idx.max_dup):
+    for d in range(idx.max_dup if idx.num_chips else 0):
         s = jnp.clip(b0 + d, 0, max(idx.num_chips - 1, 0))
         valid = in_border & (idx.border_cells[s] == cells) & \
             (b0 + d < max(idx.num_chips, 1))
@@ -945,8 +952,27 @@ def make_planned_pip_join(idx, grid: IndexSystem,
         points64 = np.asarray(points64, np.float64)[:, :2]
         n = len(points64)
         ref = None
-        for strategy, chunk in planner.pip_join_candidates(
-                n, mesh_devices):
+        cands = planner.pip_join_candidates(n, mesh_devices)
+        if mesh_devices > 1:
+            from ..config import default_config
+            if default_config().heat_prior:
+                # mosaic.heat.prior beyond the store-fed join: a hot
+                # skewed workload calibrates the skew-aware sharded
+                # path FIRST, so its warm-up (placement readbacks,
+                # bucket compiles) happens before any timed candidate
+                # and the learned coefficients favor the path the
+                # workload's heat says it needs.  Pure ordering hint:
+                # every candidate still runs and pairwise parity is
+                # still asserted, so results are bit-identical.
+                from ..obs import metrics
+                from ..obs.heat import heat
+                rep = heat.report(top=1)
+                if rep["tracked"] and rep["skew"] >= 2.0:
+                    cands = sorted(cands, key=lambda sc:
+                                   0 if sc[0] == "sharded" else 1)
+                    if metrics.enabled:
+                        metrics.count("heat/calibrate_hints")
+        for strategy, chunk in cands:
             fn = _variant(strategy, chunk)
             fn(points64)            # warm: keep compiles out of the
             t0 = _time.perf_counter()   # learned coefficients
@@ -964,6 +990,367 @@ def make_planned_pip_join(idx, grid: IndexSystem,
         return ref
 
     run.calibrate = calibrate
+    run.last_decision = None
+    return run
+
+
+# ------------------------------------------------- adaptive refinement
+
+def _chips_clean(chips: ChipSet) -> bool:
+    """True when a chipset's index is *clean*: no cell id appears in
+    both the core and border sets, and no cell is core for two
+    polygons — the same two conditions whose violation rejects the
+    dense fast path (overlap_regime / duplicate_core).
+
+    Why it matters: in a clean index a core-confident device hit
+    implies NO other polygon intersects that cell at all (any
+    intersection would have produced a chip there), so the core zone
+    is the unique container; border-only hits take the first border
+    slot, and the stable build sort keeps slots in geom-id order, so
+    they resolve to the lowest containing id — exactly
+    :func:`pip_host_truth`'s first-match rule.  Hence every point's
+    full (device + f64 recheck) output equals the host oracle, at ANY
+    resolution, which is what makes refined-vs-flat bit-parity a
+    theorem instead of a hope.  An unclean chipset (overlapping
+    polygons sharing a core cell) voids that argument — the refined
+    join then declines to refine and runs the flat path unchanged."""
+    core = chips.is_core
+    core_cells = chips.cell_id[core]
+    if len(np.intersect1d(core_cells, chips.cell_id[~core])):
+        return False
+    return len(np.unique(core_cells)) == len(core_cells)
+
+
+def make_refined_pip_join(polys: GeometryArray, grid: IndexSystem,
+                          res: int,
+                          chunk: Optional[int] = None,
+                          eps: Optional[float] = None,
+                          margin_eps: Optional[float] = None,
+                          precision: str = "auto"):
+    """Adaptive per-cell refinement of the flagship join.
+
+    The flat join pays ``max_dup`` serial chip probes per point — the
+    worst cell's duplication sets every point's cost.  This wrapper
+    starts at the caller's ``res`` exactly like the flat path, measures
+    per-cell candidate-pair selectivity from the first batch's leading
+    ``mosaic.join.refine.sample.rows`` points, and re-tessellates ONLY
+    the dense border cells' polygons ``mosaic.join.refine.depth``
+    levels deeper (arxiv 1802.09488's adaptive-grid argument).  Points
+    the f64 device cell kernel routes to a dense cell run against the
+    refined index (smaller chips, lower dup); everyone else runs
+    against the *same* base index the flat path uses.
+
+    Bit-parity: both levels are gated on :func:`_chips_clean` — a
+    clean index's output equals :func:`pip_host_truth` for every
+    point, so routing points between two clean levels cannot change a
+    single zone.  Overlap regimes fail the gate and decline to refine
+    (flat path, unchanged).  The refined part's recheck authority is
+    the polygon SUBSET whose bboxes touch a dense cell (order
+    preserved, ids remapped), which provably contains every polygon
+    that can hold a dense-routed point.
+
+    Strategy selection is the planner's ``refine/`` decision
+    (:meth:`~..sql.planner.CostPlanner.decide_refine`): learned
+    refined-vs-flat coefficients, cold dense-pair-fraction crossover,
+    ``mosaic.planner.force.refine`` pin, and the
+    ``mosaic.join.refine.enabled`` kill switch that beats any pin.
+    Kernels live in ``perf.jit_cache`` under the ``pip/refined``
+    family keyed per (level, pow2 row bucket) — a warm process with a
+    persistent cache dir compiles nothing new.  Any failure inside the
+    refined path (fault site ``join.refine``) transparently re-runs
+    the batch on the flat path (``refine_bailout`` event +
+    ``pip_join/refine_bailouts`` counter), mirroring FusionBailout.
+
+    Returns ``run(points64_abs) -> (zone [N] int32, rechecked count)``
+    with ``run.last_decision`` (the planner pick) and ``run.stats``
+    (levels / cells_refined / cells_flat / refined_points /
+    flat_points for the most recent call)."""
+    import time as _time
+    from ..config import default_config
+    from ..core.tessellate import tessellate_subset
+    from ..obs import metrics
+    from ..obs.inflight import (QueryCancelled, checkpoint, note_refine,
+                                note_strategies)
+    from ..perf.bucketing import pow2_bucket
+    from ..perf.jit_cache import kernel_cache
+    from ..resilience import faults
+    from ..sql.planner import Decision, planner
+
+    chunk = _resolve_chunk(chunk)
+    chips = tessellate(polys, res, grid, keep_core_geom=False)
+    idx_base = build_pip_index(polys, res, grid, chips=chips,
+                               dense="never")
+    clean_base = _chips_clean(chips)
+    recheck_base = host_recheck_fn(idx_base, polys)
+    b_cells = chips.cell_id[~chips.is_core]
+    u_cells, u_dup = (np.unique(b_cells, return_counts=True)
+                      if len(b_cells) else
+                      (np.empty(0, np.int64), np.empty(0, np.int64)))
+    state = {"probed": False, "dense": np.empty(0, np.int64),
+             "frac": 0.0, "depth": 0, "ref": None, "ref_unclean": False,
+             "flat": None, "route_host": False}
+
+    def _route_cells(pts64: np.ndarray) -> np.ndarray:
+        """Base-level cell ids for the hot/cold routing split, via the
+        jitted device kernel (f64 under the global x64 switch,
+        canonical-pinned against the host path by
+        tests/test_h3_canonical.py) — the interpreted host assignment
+        at flagship sizes costs more than the join itself.  Routing is
+        never answer authority: a cold-routed point runs the full base
+        index, and _ensure_refined's bbox inflation holds every
+        polygon that can contain a hot-routed point, so either routing
+        outcome yields the oracle zone."""
+        rows = len(pts64)
+        if rows == 0:
+            return np.empty(0, np.int64)
+        if not state["route_host"]:
+            try:
+                per = pow2_bucket(rows, floor=64)
+                buf = np.empty((per, 2), np.float64)
+                buf[:rows] = pts64
+                buf[rows:] = pts64[0]
+                fn = kernel_cache.get_or_build(
+                    "pip/route", (id(grid), res, per),
+                    lambda: jax.jit(
+                        lambda p: grid.point_to_cell_jax(p, res)))
+                return np.asarray(fn(jnp.asarray(buf)))[:rows]
+            except Exception:       # host-only grid: route there instead
+                state["route_host"] = True
+        return grid.point_to_cell(pts64, res)
+
+    def _probe(points64: np.ndarray) -> None:
+        """Sticky selectivity probe: per-border-cell estimated
+        candidate pairs = (sample points in cell) x (chips in cell)."""
+        cfg = default_config()
+        sample = points64[:max(1, int(cfg.join_refine_sample_rows))]
+        if not len(u_cells) or not len(sample):
+            return
+        cells = _route_cells(np.asarray(sample, np.float64))
+        pos = np.searchsorted(u_cells, cells)
+        posc = np.clip(pos, 0, len(u_cells) - 1)
+        valid = (pos < len(u_cells)) & (u_cells[posc] == cells)
+        counts = np.bincount(posc[valid], minlength=len(u_cells))
+        pairs = counts.astype(np.float64) * u_dup
+        total = float(pairs.sum())
+        floor = int(cfg.join_refine_dup_threshold)
+        sel = np.nonzero((u_dup >= floor) & (counts > 0))[0]
+        cap = max(1, int(cfg.join_refine_max_cells))
+        if len(sel) > cap:
+            sel = sel[np.argsort(-pairs[sel], kind="stable")[:cap]]
+        state["dense"] = np.sort(u_cells[sel])
+        state["frac"] = float(pairs[sel].sum()) / total if total else 0.0
+
+    def _ensure_refined(depth: int) -> bool:
+        """Build the deeper index over the dense cells' polygons once
+        (sticky at the first requested depth); False = parity gate
+        failed at the refined level, caller must run flat."""
+        if state["ref"] is not None:
+            return True
+        if state["ref_unclean"]:
+            return False
+        dense = state["dense"]
+        if not len(dense):
+            state["ref"] = {"empty": True}
+            state["depth"] = max(1, int(depth))
+            return True
+        verts, counts = grid.cell_boundary(dense)
+        m = np.arange(verts.shape[1])[None, :] < counts[:, None]
+        vx, vy = verts[..., 0], verts[..., 1]
+        cb = np.stack([np.where(m, vx, np.inf).min(1),
+                       np.where(m, vy, np.inf).min(1),
+                       np.where(m, vx, -np.inf).max(1),
+                       np.where(m, vy, -np.inf).max(1)], axis=1)
+        # inflate by the chord-vs-gnomonic sagitta: the true cell edge
+        # can bow past the vertex-chord bbox, and the subset must hold
+        # EVERY polygon that can contain a dense-routed point
+        pad = max(1e-9, 2.0 * float(idx_base.sagitta_deg))
+        cb += np.array([-pad, -pad, pad, pad])
+        pb = polys.bboxes()
+        inter = ~((pb[:, None, 0] > cb[None, :, 2]) |
+                  (pb[:, None, 2] < cb[None, :, 0]) |
+                  (pb[:, None, 1] > cb[None, :, 3]) |
+                  (pb[:, None, 3] < cb[None, :, 1]))
+        sub_ids = np.nonzero(inter.any(axis=1))[0]
+        depth = max(1, int(depth))
+        sub, sub_chips = tessellate_subset(polys, sub_ids, res + depth,
+                                           grid, keep_core_geom=False)
+        if not _chips_clean(sub_chips):
+            state["ref_unclean"] = True
+            return False
+        idx_ref = build_pip_index(sub, res + depth, grid,
+                                  chips=sub_chips, dense="never")
+        state["ref"] = {"idx": idx_ref, "orig": sub_ids.astype(np.int32),
+                        "recheck": host_recheck_fn(idx_ref, sub)}
+        state["depth"] = depth
+        return True
+
+    def _kernel(idx_level, rows: int):
+        # one entry per (level index, pow2 bucket): a warm process
+        # with a persistent cache dir loads both executables from disk
+        return kernel_cache.get_or_build(
+            "pip/refined",
+            (id(idx_level), idx_level.res, rows, eps, margin_eps,
+             precision),
+            lambda: jax.jit(make_pip_join_fn(
+                idx_level, grid, eps, margin_eps, precision)))
+
+    def _run_part(idx_level, recheck, pts64: np.ndarray):
+        rows = len(pts64)
+        if rows == 0:
+            return np.empty(0, np.int32), 0
+        # greedy pow2 decomposition rather than one rounded-up bucket:
+        # the hot/cold split lands wherever the data says (a 51% part
+        # would pad to ~2x its rows, and padding rows run the kernel
+        # at full price).  Stopping an eighth below the leading bucket
+        # bounds the waste at 12.5% across at most 5 launches, every
+        # one still cached per (level, bucket).
+        lead = 1 << (rows.bit_length() - 1)
+        floor = max(64, lead >> 3)
+        origin = np.asarray(idx_level.origin)[None]
+        z = np.empty(rows, np.int32)
+        unc = np.empty(rows, bool)
+        s = 0
+        while s < rows:
+            rem = rows - s
+            per = max(floor, 1 << (rem.bit_length() - 1))
+            take = min(rem, per)
+            buf = np.full((per, 2), _PAD_SENTINEL_DEG, np.float32)
+            # f64 origin shift before the f32 cast (= localize()); pad
+            # rows keep the sentinel and resolve to -1 without recheck
+            buf[:take] = np.asarray(pts64[s:s + take] - origin,
+                                    np.float32)
+            zz, uu = _kernel(idx_level, per)(jnp.asarray(buf))
+            z[s:s + take] = np.asarray(zz)[:take]
+            unc[s:s + take] = np.asarray(uu)[:take]
+            s += take
+        return recheck(pts64, z, unc), int(unc.sum())
+
+    def _flat():
+        if state["flat"] is None:
+            state["flat"] = make_streamed_pip_join(
+                idx_base, grid, polys=polys, chunk=chunk, eps=eps,
+                margin_eps=margin_eps, precision=precision)
+        return state["flat"]
+
+    def _refined(points64: np.ndarray):
+        from ..obs import tracer
+        from ..obs.context import root_trace
+        ref = state["ref"]
+        dense = state["dense"]
+        n = len(points64)
+        zone = np.empty(n, np.int32)
+        rechecked = refined_pts = 0
+        with root_trace("pip_join"), tracer.span("pip_join/refined"):
+            for sl in chunk_rows(n, chunk):
+                checkpoint()
+                faults.maybe_fail("join.refine")
+                pts = points64[sl]
+                if len(dense) and "idx" in ref:
+                    cells = _route_cells(pts)
+                    pos = np.searchsorted(dense, cells)
+                    posc = np.clip(pos, 0, len(dense) - 1)
+                    hot = (pos < len(dense)) & (dense[posc] == cells)
+                else:
+                    hot = np.zeros(len(pts), bool)
+                out = np.empty(len(pts), np.int32)
+                za, ra = _run_part(idx_base, recheck_base, pts[~hot])
+                out[~hot] = za
+                if hot.any():
+                    zb, rb = _run_part(ref["idx"], ref["recheck"],
+                                       pts[hot])
+                    orig = ref["orig"]
+                    out[hot] = np.where(
+                        zb >= 0, orig[np.clip(zb, 0, len(orig) - 1)],
+                        np.int32(-1))
+                    rechecked += rb
+                    refined_pts += int(hot.sum())
+                rechecked += ra
+                zone[sl] = out
+        return zone, rechecked, refined_pts
+
+    def run(points64: np.ndarray):
+        points64 = np.asarray(points64, np.float64)[:, :2]
+        n = len(points64)
+        if not state["probed"]:
+            _probe(points64)
+            state["probed"] = True
+        if not clean_base:
+            # parity gate, not a cost call: the clean-index theorem
+            # doesn't hold here, so refinement is off the table no
+            # matter what the planner (or a pin) would prefer
+            d = Decision("refine", "flat",
+                         "overlap regime at base level (parity gate)",
+                         n, cost_key="refine/flat", key_n=n,
+                         forced=True)
+            d.depth = 0
+            planner.record_decision(d)
+        else:
+            d = planner.decide_refine(n, state["frac"],
+                                      idx_base.max_dup)
+            if d.strategy == "refined" and \
+                    not _ensure_refined(getattr(d, "depth", 1)):
+                d.strategy = "flat"
+                d.reason = ("overlap regime at refined level "
+                            "(parity gate)")
+                d.cost_key = "refine/flat"
+                d.forced = True
+                planner.record_decision(d)
+        t0 = _time.perf_counter()
+        refined_pts = 0
+        bailed = False
+        if d.strategy == "refined":
+            try:
+                zone, rechecked, refined_pts = _refined(points64)
+            except (QueryCancelled, KeyboardInterrupt):
+                raise
+            except Exception as e:          # transparent flat fallback
+                bailed = True
+                if metrics.enabled:
+                    metrics.count("pip_join/refine_bailouts")
+                from ..obs.recorder import recorder
+                recorder.record("refine_bailout",
+                                error=type(e).__name__,
+                                detail=str(e)[:200], rows=n)
+                refined_pts = 0
+                zone, rechecked = _flat()(points64)
+        else:
+            zone, rechecked = _flat()(points64)
+        wall = _time.perf_counter() - t0
+        planner.observe_decision(d, wall,
+                                 rows_out=int((zone >= 0).sum()))
+        depth = state["depth"] or int(getattr(d, "depth", 1) or 1)
+        # stats describe what RAN (the decision object keeps what was
+        # decided — they differ exactly when a bailout demoted the run)
+        refined_run = (d.strategy == "refined" and not bailed
+                       and state["ref"] is not None and refined_pts > 0)
+        cells_refined = len(state["dense"]) if refined_run else 0
+        stats = {
+            "levels": [res, res + depth] if refined_run else [res],
+            "cells_refined": cells_refined,
+            "cells_flat": len(u_cells) - cells_refined,
+            "refined_points": int(refined_pts),
+            "flat_points": int(n - refined_pts),
+            "strategy": "refined" if refined_run else "flat",
+        }
+        if metrics.enabled and refined_pts:
+            metrics.count("pip_join/refined_points",
+                          float(refined_pts))
+        note_strategies({"refine": d.label + (" (bailout)" if bailed
+                                              else "")})
+        if refined_run:
+            summary = (f"L{res}+{depth}: {cells_refined} refined / "
+                       f"{stats['cells_flat']} flat cells, "
+                       f"{refined_pts}/{n} pts")
+        else:
+            summary = "flat"
+        note_refine({k: stats[k] for k in
+                     ("cells_refined", "cells_flat", "refined_points",
+                      "flat_points")}, summary=summary)
+        run.stats = stats
+        run.last_decision = d
+        return zone, rechecked
+
+    run.stats = None
     run.last_decision = None
     return run
 

@@ -381,7 +381,10 @@ class SQLSession:
                 return f"{scanned}/{len(store.partitions)}"
             # est_bytes: the planner's byte pre-pass (cardinality x
             # source row width; -1 = no estimate) — what the memory
-            # budget's admission check reads
+            # budget's admission check reads; refine: the adaptive
+            # PIP-refinement pick per operator — static plans have
+            # none (the decision needs the first batch's selectivity
+            # probe), so the column is "-" until EXPLAIN ANALYZE
             return Table({"operator": [o for o, _ in ops],
                           "detail": [d for _, d in ops],
                           "strategy": [plan.label(o) if plan is not None
@@ -391,11 +394,30 @@ class SQLSession:
                               np.int64),
                           "partitions": [_partitions(o)
                                          for o, _ in ops],
+                          "refine": ["-" for _ in ops],
                           "fused": [fplan.gid_for(o) if fplan is not None
                                     else "-" for o, _ in ops]})
         if q.explain == "analyze":
             prof: List[tuple] = []
             self._execute(q, prof)
+            # refine column: the per-call refinement summaries the
+            # adaptive join noted on this query's ticket (levels used /
+            # cells refined / cells flat), attributed to the operator
+            # the ticket was in when each refined join ran; summaries
+            # noted outside any operator stage roll up on the first
+            # (scan/join) row.  The ticket is still open here — it
+            # completes in sql()'s finally, after this table is built.
+            from ..obs.context import current_trace_id
+            from ..obs.inflight import inflight as _inflight
+            tkt = _inflight.ticket_for_trace(current_trace_id())
+            rops = list(tkt.refine_ops) if tkt is not None else []
+            prof_ops = {p[0] for p in prof}
+
+            def _refine_for(i: int, op: str) -> str:
+                hits = [s for o, s in rops if o == op]
+                if i == 0:
+                    hits += [s for o, s in rops if o not in prof_ops]
+                return "; ".join(hits) if hits else "-"
             # all_to_all_bytes / shard_skew attribute the sharded
             # exchange (parallel/overlay collective accounting) to the
             # operator row that moved the bytes — zero rows mean the
@@ -424,6 +446,8 @@ class SQLSession:
                           "shard_skew": np.asarray(
                               [p[5] for p in prof]),
                           "device_ms": [p[7] for p in prof],
+                          "refine": [_refine_for(i, p[0])
+                                     for i, p in enumerate(prof)],
                           "fused": [p[8] for p in prof],
                           "peak_bytes": np.asarray(
                               [p[9] for p in prof], np.int64)})

@@ -65,6 +65,7 @@ FORCE_CHOICES = {
     "knn": ("auto", "brute", "ring"),
     "pip_join": ("auto", "monolithic", "streamed", "sharded"),
     "fusion": ("auto", "on", "off"),
+    "refine": ("auto", "refined", "flat"),
 }
 
 #: EWMA weight of the newest observation in the coefficient store
@@ -79,6 +80,11 @@ _JOIN_VECTOR_CROSSOVER = 4096
 #: beats the saved host round-trips (cold-start crossover; learned
 #: fused-vs-unfused coefficients override it once calibrated)
 _FUSION_CROSSOVER = 1024
+#: cold-start crossover for adaptive PIP refinement: refine only when
+#: at least this fraction of the estimated candidate pairs sits in the
+#: dense cells (otherwise the second index buys back too little probe
+#: work); learned refined-vs-flat coefficients override it
+_REFINE_PAIR_CROSSOVER = 0.5
 
 
 @dataclasses.dataclass
@@ -417,6 +423,59 @@ class Planner:
                    f"{_FUSION_CROSSOVER} crossover (cold)")
         return self.record_decision(Decision(
             "fusion", s, why, n, cost_key=f"fusion/{opset}", key_n=n))
+
+    def decide_refine(self, n: int, dense_pair_frac: float,
+                      max_dup: int, depth: Optional[int] = None
+                      ) -> Decision:
+        """Adaptive per-cell PIP refinement vs. the flat single-level
+        join (bit-identical either way — the refined path shares the
+        flat path's base index and only re-tessellates the dense cells'
+        polygons one level deeper; see ``make_refined_pip_join``).
+
+        ``dense_pair_frac`` is the measured selectivity signal: the
+        fraction of estimated candidate pairs (sampled points x chips
+        sharing their cell) that land in the dense-cell set.
+        ``max_dup`` is the base index's probe width — when every cell
+        holds few chips there is nothing to refine away.  The kill
+        switch (``mosaic.join.refine.enabled = false``) beats any pin,
+        mirroring fusion's contract."""
+        from ..config import default_config
+        cfg = default_config()
+        if depth is None:
+            depth = int(getattr(cfg, "join_refine_depth", 1))
+        if not bool(getattr(cfg, "join_refine_enabled", True)):
+            d = Decision("refine", "flat", "disabled by conf", n,
+                         cost_key="refine/flat", key_n=n, forced=True)
+            d.depth = depth
+            return self.record_decision(d)
+        forced = self.force_for("refine")
+        if forced != "auto":
+            d = Decision("refine", forced, "forced by conf", n,
+                         cost_key=f"refine/{forced}", key_n=n,
+                         forced=True)
+            d.depth = depth
+            return self.record_decision(d)
+        dup_floor = int(getattr(cfg, "join_refine_dup_threshold", 8))
+        c_r = self.est_cost_ms("refine/refined", n)
+        c_f = self.est_cost_ms("refine/flat", n)
+        if c_r is not None and c_f is not None:
+            s = "refined" if c_r <= c_f else "flat"
+            why = (f"learned {min(c_r, c_f):.3g}ms vs "
+                   f"{max(c_r, c_f):.3g}ms at {_fmt_rows(n)} rows")
+        elif dense_pair_frac >= _REFINE_PAIR_CROSSOVER and \
+                max_dup >= dup_floor:
+            s = "refined"
+            why = (f"dense pair frac {dense_pair_frac:.2f} >= "
+                   f"{_REFINE_PAIR_CROSSOVER} at dup {max_dup} (cold)")
+        else:
+            s = "flat"
+            why = (f"dense pair frac {dense_pair_frac:.2f} < "
+                   f"{_REFINE_PAIR_CROSSOVER} or dup {max_dup} < "
+                   f"{dup_floor} (cold)")
+        d = Decision("refine", s, why, n, cost_key=f"refine/{s}",
+                     key_n=n)
+        d.depth = depth           # dynamic attr: levels to deepen by
+        return self.record_decision(d)
 
     # ----------------------------------------------------- SQL pre-pass
 

@@ -38,12 +38,25 @@ class StoreWriter:
     """Incremental grid-partitioned ingest; call :meth:`append` any
     number of times, then :meth:`finalize` exactly once."""
 
-    def __init__(self, root: str, *, grid_res: Optional[int] = None,
+    def __init__(self, root: str, *, grid_res=None,
                  shard_rows: Optional[int] = None,
                  point_cols: Tuple[str, str] = ("x", "y")):
         from .. import config as _config
         cfg = _config.default_config()
         self.root = str(root)
+        if isinstance(grid_res, str):
+            # learned layout: resolve "auto" through the advisor
+            # (sql/layout.py) — heat/history workload evidence, else
+            # the configured default.  shard_rows follows the advice
+            # unless pinned explicitly.
+            if grid_res != "auto":
+                raise ValueError(
+                    f"grid_res={grid_res!r} invalid: an int or 'auto'")
+            from ..sql.layout import advise_layout
+            adv = advise_layout()
+            grid_res = adv.grid_res
+            if shard_rows is None:
+                shard_rows = adv.shard_rows
         self.grid_res = int(grid_res or cfg.store_grid_res)
         self.shard_rows = int(shard_rows or cfg.store_shard_rows)
         self.point_cols = (str(point_cols[0]), str(point_cols[1]))

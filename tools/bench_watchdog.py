@@ -46,6 +46,7 @@ __all__ = ["LOWER_BETTER", "HIGHER_BETTER", "TREND_ONLY",
 # sync by tests/test_timeseries.py::test_watchdog_metric_lists).
 LOWER_BETTER = ["device_ms", "end_to_end_ms", "flagship_join_p95_ms",
                 "planner_flagship_ms", "fused_flagship_ms",
+                "refined_flagship_ms",
                 "serving_p95_ms",
                 "sharded_end_to_end_ms",
                 "tessellate_zones_s",
@@ -65,7 +66,13 @@ TREND_ONLY = ["memory.flagship_peak_bytes",
               # yield drift, plus the partition-heat skew trajectory
               "history.records_written",
               "history.compaction_ratio",
-              "history.heat.skew"]
+              "history.heat.skew",
+              # adaptive join refinement: what fraction of occupied
+              # cells the probe sent deep, and the layout advisor's
+              # chosen grid — drift in either means the workload (or
+              # the learned coefficients) moved
+              "refine.cells_refined_frac",
+              "layout.chosen_res"]
 
 # Out-of-core store metrics (the bench's "store" block, first recorded
 # in BENCH_r07): trended from their first appearance, but they join

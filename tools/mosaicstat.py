@@ -11,6 +11,7 @@ CLI reads that directory — raw segments and compacted summaries alike
     python tools/mosaicstat.py strategies --dir /tmp/hist
     python tools/mosaicstat.py heatmap    --dir /tmp/hist --top 20
     python tools/mosaicstat.py diff       --dir /tmp/hist --json
+    python tools/mosaicstat.py layout     --store /tmp/store
     python tools/mosaicstat.py report     --dir /tmp/hist
 
 * ``top``        — the costliest raw-record queries by ``--by``
@@ -26,6 +27,10 @@ CLI reads that directory — raw segments and compacted summaries alike
   most recent windows: per-operator p50/p95 slips, flagged past the
   20% threshold (exit code 3 when anything is flagged, so a CI lane
   can gate on it).  ``--json`` emits the machine-readable verdict.
+* ``layout``     — the learned store-layout recommendation
+  (``mosaic_tpu.sql.layout.advise_layout``): grid res + shard rows
+  from an existing store's manifest (``--store``) plus whatever heat
+  and history evidence the history dirs contribute.
 * ``report``     — the full merged JSON report (all windows + totals).
 
 ``--dir`` defaults to ``MOSAIC_TPU_HISTORY_DIR`` then the configured
@@ -178,6 +183,30 @@ def cmd_diff(dirs, args) -> int:
     return 3 if verdict["flagged"] else 0
 
 
+def cmd_layout(dirs, args) -> int:
+    from mosaic_tpu.sql.layout import advise_layout
+    adv = advise_layout(store_root=args.store or None,
+                        history_dir=dirs[0] if dirs else None)
+    if args.json:
+        json.dump({"grid_res": adv.grid_res,
+                   "shard_rows": adv.shard_rows,
+                   "reason": adv.reason,
+                   "evidence": adv.evidence},
+                  sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    print(f"recommended mosaic.store.grid.res   = {adv.grid_res}")
+    print(f"recommended mosaic.store.shard.rows = {adv.shard_rows}")
+    print(f"why: {adv.reason}")
+    for src, ev in adv.evidence.items():
+        print(f"  {src}: {ev}")
+    if args.store:
+        print(f"rewrite: mosaic_tpu.sql.layout.rewrite_store("
+              f"{args.store!r}, <dst>) re-buckets and proves "
+              f"read-back bit-parity")
+    return 0
+
+
 def cmd_report(dirs, args) -> int:
     rep = _merged(dirs, args.window_ms)
     json.dump(rep, sys.stdout, indent=2, default=str)
@@ -229,6 +258,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                        help="window-over-window regression check")
     p.add_argument("--json", action="store_true",
                    help="machine-readable verdict")
+    p = sub.add_parser("layout", parents=[common],
+                       help="learned store-layout recommendation")
+    p.add_argument("--store", default=None,
+                   help="existing store root whose manifest seeds "
+                        "the evidence (else heat/history only)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable recommendation")
     sub.add_parser("report", parents=[common],
                    help="full merged JSON report")
     args = ap.parse_args(argv)
@@ -239,14 +275,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.window_ms = args.window_ms_after
 
     dirs = _resolve_dirs(args)
-    if not dirs:
+    if not dirs and args.cmd != "layout":
+        # layout can run from a store manifest (or heat) alone
         print("mosaicstat: no history dir (--dir, "
               "MOSAIC_TPU_HISTORY_DIR, or SET mosaic.history.dir)",
               file=sys.stderr)
         return 2
     handler = {"top": cmd_top, "principals": cmd_principals,
                "strategies": cmd_strategies, "heatmap": cmd_heatmap,
-               "diff": cmd_diff, "report": cmd_report}[args.cmd]
+               "diff": cmd_diff, "layout": cmd_layout,
+               "report": cmd_report}[args.cmd]
     rc = handler(dirs, args)
     if rc == 1 and args.cmd != "diff":   # diff prints its own reason
         print(f"mosaicstat: no records under "
